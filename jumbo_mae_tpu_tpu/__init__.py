@@ -1,0 +1,24 @@
+"""jumbo_mae_tpu_tpu — a TPU-native JAX framework for Jumbo Masked Autoencoders.
+
+A ground-up GSPMD/pjit rebuild of the capabilities of
+``antofuller/jumbo_mae_tpu`` (mounted read-only at ``/root/reference``):
+MAE pretraining, supervised finetuning and linear probing of "Jumbo" ViTs
+(multiple CLS tokens mixed by a shared wide MLP each layer) on ImageNet-1k
+style tar shards, across TPU pod slices.
+
+Design stance (see SURVEY.md §7):
+
+- one ``jax.jit``-compiled train step over an explicit ``Mesh(("data","fsdp"))``
+  with ``NamedSharding`` — no ``pmap`` anywhere;
+- gradient accumulation as a ``lax.scan`` inside the step, not a host-visible
+  micro-step state machine;
+- a single fold-in RNG (seed ⊕ process ⊕ step ⊕ stream) instead of threaded
+  split keys — reproducible and immune to the reference's RNG-shadowing defect
+  (``/root/reference/src/finetuning.py:136-154``);
+- torch-free streaming input pipeline with device-side prefetch;
+- Orbax checkpointing of the full train state with true resume;
+- Pallas kernels for the hot attention path, ring attention over a mesh axis
+  for long sequences.
+"""
+
+__version__ = "0.1.0"

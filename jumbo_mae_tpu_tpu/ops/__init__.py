@@ -1,0 +1,21 @@
+from jumbo_mae_tpu_tpu.ops.masking import (
+    index_sequence,
+    random_masking,
+    unshuffle_with_mask_tokens,
+)
+from jumbo_mae_tpu_tpu.ops.patches import (
+    extract_patches,
+    merge_patches,
+    patch_mse_loss,
+)
+from jumbo_mae_tpu_tpu.ops.posemb import sincos2d_positional_embedding
+
+__all__ = [
+    "index_sequence",
+    "random_masking",
+    "unshuffle_with_mask_tokens",
+    "extract_patches",
+    "merge_patches",
+    "patch_mse_loss",
+    "sincos2d_positional_embedding",
+]

@@ -1,0 +1,95 @@
+"""Random patch masking for MAE pretraining.
+
+Behavioral parity target: ``random_masking`` / ``index_sequence`` in
+``/root/reference/src/utils_mae.py:84-102``. The reference draws ONE uniform
+noise vector of shape ``(length,)`` — a single permutation shared by the whole
+per-device batch (upstream facebookresearch/mae permutes per sample). Shared
+mode is the parity default here; ``per_sample`` mode is also provided because
+it is strictly stronger as an augmentation and costs one batched argsort.
+
+TPU notes: the shared-mode gather is a ``take`` along the sequence axis with a
+traced 1-D index — XLA lowers it to a dynamic-gather that is cheap at these
+sizes. ``ids_restore`` is carried to the decoder to unshuffle mask tokens;
+``unshuffle_with_mask_tokens`` fuses the concat+gather so the scatter never
+materializes an intermediate in HBM larger than the output.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+MaskMode = Literal["shared", "per_sample"]
+
+
+def index_sequence(x: jax.Array, ids: jax.Array) -> jax.Array:
+    """Gather along the sequence (second) axis.
+
+    ``ids`` may be 1-D (shared permutation, applied to every batch row) or 2-D
+    ``(batch, n)`` (per-sample permutation).
+    """
+    if ids.ndim == 1:
+        return jnp.take(x, ids, axis=1)
+    idx = ids.reshape(ids.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, idx, axis=1)
+
+
+def random_masking(
+    x: jax.Array,
+    rng: jax.Array,
+    keep_len: int,
+    *,
+    mode: MaskMode = "shared",
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Randomly drop all but ``keep_len`` tokens of ``x`` (batch, len, dim).
+
+    Returns ``(kept, mask, ids_restore)`` where ``kept`` is
+    ``(batch, keep_len, dim)``, ``mask`` is ``(batch, len)`` float32 with 1 at
+    MASKED positions, and ``ids_restore`` inverts the shuffle (1-D in shared
+    mode, 2-D in per-sample mode).
+    """
+    batch, length, _ = x.shape
+    if mode == "shared":
+        noise = jax.random.uniform(rng, (length,), dtype=jnp.float32)
+        ids_shuffle = jnp.argsort(noise)
+        ids_restore = jnp.argsort(ids_shuffle)
+        kept = index_sequence(x, ids_shuffle[:keep_len])
+        shuffled_mask = (jnp.arange(length) >= keep_len).astype(jnp.float32)
+        mask = jnp.broadcast_to(shuffled_mask[ids_restore], (batch, length))
+        return kept, mask, ids_restore
+
+    if mode == "per_sample":
+        noise = jax.random.uniform(rng, (batch, length), dtype=jnp.float32)
+        ids_shuffle = jnp.argsort(noise, axis=1)
+        ids_restore = jnp.argsort(ids_shuffle, axis=1)
+        kept = index_sequence(x, ids_shuffle[:, :keep_len])
+        shuffled_mask = jnp.broadcast_to(
+            (jnp.arange(length) >= keep_len).astype(jnp.float32), (batch, length)
+        )
+        mask = jnp.take_along_axis(shuffled_mask, ids_restore, axis=1)
+        return kept, mask, ids_restore
+
+    raise ValueError(f"unknown masking mode: {mode!r}")
+
+
+def unshuffle_with_mask_tokens(
+    visible: jax.Array,
+    mask_token: jax.Array,
+    ids_restore: jax.Array,
+) -> jax.Array:
+    """Restore the full sequence from visible tokens + a learned mask token.
+
+    ``visible`` is ``(batch, keep_len, dim)``; ``mask_token`` broadcastable to
+    ``(batch, length - keep_len, dim)``; ``ids_restore`` the inverse
+    permutation from :func:`random_masking`. The number of mask tokens is
+    derived as ``length - keep_len`` (the reference instead recomputes it as
+    ``int(length * mask_ratio)``, which disagrees with ``keep_len`` for some
+    ratios — ``/root/reference/src/pretraining.py:100-103``; fixed here).
+    """
+    batch, keep_len, dim = visible.shape
+    length = ids_restore.shape[-1]
+    mask_tokens = jnp.broadcast_to(mask_token, (batch, length - keep_len, dim))
+    full = jnp.concatenate([visible, mask_tokens.astype(visible.dtype)], axis=1)
+    return index_sequence(full, ids_restore)
