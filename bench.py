@@ -1,0 +1,109 @@
+"""Round benchmark: MAE ViT-L/16 pretrain throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference published no throughput numbers (BASELINE.md), so the baseline
+here is a faithful *reference-style* configuration of the same workload run
+on the same chip: float32 compute (the reference's flax modules never cast
+to bfloat16) with the same model/masking/optimizer. ``vs_baseline`` is
+(this framework's bf16 throughput) / (reference-style fp32 throughput).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_step(dtype: str, batch_size: int):
+    import jax
+
+    from jumbo_mae_tpu_tpu.models import DecoderConfig, MAEPretrainModel, preset
+    from jumbo_mae_tpu_tpu.parallel import MeshConfig, create_mesh
+    from jumbo_mae_tpu_tpu.train import (
+        OptimConfig,
+        create_sharded_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    mesh = create_mesh(
+        MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1]
+    )
+    enc = preset(
+        "vit_l16", mask_ratio=0.75, labels=None, posemb="sincos2d", dtype=dtype
+    )
+    dec = DecoderConfig(layers=8, dim=512, heads=16, dtype=dtype)
+    module = MAEPretrainModel(enc, dec, norm_pix_loss=True)
+
+    batch = {
+        "images": np.random.RandomState(0).randint(
+            0, 256, (batch_size, 224, 224, 3), dtype=np.uint8
+        )
+    }
+    tx = make_optimizer(
+        OptimConfig(
+            name="adamw",
+            learning_rate=1.5e-4,
+            b2=0.95,
+            weight_decay=0.05,
+            warmup_steps=100,
+            training_steps=10_000,
+        ),
+        global_batch_size=batch_size,
+    )
+    state, sharding = create_sharded_state(
+        module, tx, batch, mesh, mode="pretrain"
+    )
+    step = make_train_step(mesh, sharding, mode="pretrain")
+    return step, state, batch
+
+
+def time_steps(step, state, batch, *, warmup: int, iters: int) -> float:
+    import jax
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+
+    step, state, batch = build_step("bfloat16", batch_size)
+    dt = time_steps(step, state, batch, warmup=3, iters=iters)
+    imgs_per_sec = batch_size / dt
+    del step, state
+
+    baseline_env = os.environ.get("BENCH_SKIP_BASELINE")
+    if baseline_env:
+        ratio = float("nan")
+    else:
+        step_f32, state_f32, batch = build_step("float32", batch_size)
+        dt_f32 = time_steps(step_f32, state_f32, batch, warmup=2, iters=max(4, iters // 2))
+        ratio = (batch_size / dt_f32) and imgs_per_sec / (batch_size / dt_f32)
+
+    print(
+        json.dumps(
+            {
+                "metric": "mae_vit_l16_224_pretrain_imgs_per_sec_per_chip",
+                "value": round(imgs_per_sec, 2),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(ratio, 3) if ratio == ratio else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

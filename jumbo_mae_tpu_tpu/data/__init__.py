@@ -1,0 +1,3 @@
+from jumbo_mae_tpu_tpu.data.synthetic import synthetic_batches
+
+__all__ = ["synthetic_batches"]

@@ -1,0 +1,20 @@
+from jumbo_mae_tpu_tpu.models.config import (
+    DecoderConfig,
+    JumboViTConfig,
+    PRESETS,
+    preset,
+)
+from jumbo_mae_tpu_tpu.models.vit import JumboViT
+from jumbo_mae_tpu_tpu.models.mae import MAEDecoder, MAEPretrainModel
+from jumbo_mae_tpu_tpu.models.classifier import ClassificationModel
+
+__all__ = [
+    "DecoderConfig",
+    "JumboViTConfig",
+    "PRESETS",
+    "preset",
+    "JumboViT",
+    "MAEDecoder",
+    "MAEPretrainModel",
+    "ClassificationModel",
+]

@@ -1,0 +1,87 @@
+"""Supervised classification model: finetune and linear probe.
+
+Parity: ``FinetuneModule``, ``/root/reference/src/finetuning.py:78-106`` —
+on-device normalization, one-hot + label smoothing, Mixup/CutMix in training,
+CE/BCE criteria, and top-1/top-5 accuracy computed as membership of the
+predicted classes in the label *set* (multi-label safe after mixup).
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from jumbo_mae_tpu_tpu.models.config import JumboViTConfig
+from jumbo_mae_tpu_tpu.models.vit import JumboViT
+from jumbo_mae_tpu_tpu.ops.mixup import mixup_cutmix
+from jumbo_mae_tpu_tpu.ops.preprocess import normalize_images
+
+Criterion = Literal["ce", "bce"]
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.softmax_cross_entropy(logits, labels)
+
+
+def binary_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.sigmoid_binary_cross_entropy(logits, labels > 0).mean(-1)
+
+
+CRITERIA = {"ce": cross_entropy, "bce": binary_cross_entropy}
+
+
+class ClassificationModel(nn.Module):
+    """uint8 images + integer (or soft) labels → per-sample loss/acc metrics."""
+
+    encoder_cfg: JumboViTConfig
+    mixup_alpha: float = 0.0
+    cutmix_alpha: float = 0.0
+    label_smoothing: float = 0.0
+    criterion: Criterion = "ce"
+
+    def setup(self):
+        if (self.encoder_cfg.labels or 0) <= 0:
+            raise ValueError("ClassificationModel requires encoder_cfg.labels > 0")
+        self.model = JumboViT(
+            self.encoder_cfg.replace(mask_ratio=None), name="model"
+        )
+
+    def __call__(
+        self, images: jax.Array, labels: jax.Array, deterministic: bool = True
+    ) -> dict[str, jax.Array]:
+        cfg = self.encoder_cfg
+        images = normalize_images(images, dtype=cfg.compute_dtype)
+
+        if labels.ndim == 1:
+            labels = nn.one_hot(labels, cfg.labels)
+        labels = labels.astype(jnp.float32)
+
+        if not deterministic:
+            if self.criterion == "ce" and self.label_smoothing > 0:
+                labels = optax.smooth_labels(labels, self.label_smoothing)
+            if self.mixup_alpha > 0 or self.cutmix_alpha > 0:
+                images, labels = mixup_cutmix(
+                    self.make_rng("mixup"),
+                    images,
+                    labels,
+                    self.mixup_alpha,
+                    self.cutmix_alpha,
+                )
+
+        logits = self.model(images, deterministic).astype(jnp.float32)
+        loss = CRITERIA[self.criterion](logits, labels)
+
+        # Top-k accuracy as membership in the per-sample label set — exact for
+        # single-label data and meaningful after mixup (multi-label).
+        label_set = labels == labels.max(-1, keepdims=True)
+        top5 = jax.lax.top_k(logits, k=5)[1]
+        hits = jnp.take_along_axis(label_set, top5, axis=-1)
+        return {
+            "loss": loss,
+            "acc1": hits[:, 0].astype(jnp.float32),
+            "acc5": hits.any(-1).astype(jnp.float32),
+        }

@@ -1,0 +1,141 @@
+"""Model configuration dataclasses and presets.
+
+Replaces the reference's ``ViTBase``/``MAEDecoderBase`` dataclass-mixin
+pattern (``/root/reference/src/modeling.py:35-104``) with plain frozen config
+objects passed to modules as a single attribute — hashable, serializable, and
+independent of module inheritance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Literal
+
+import jax.numpy as jnp
+
+Posemb = Literal["learnable", "sincos2d"]
+Pooling = Literal["cls", "gap"]
+AttnImpl = Literal["einsum", "flash", "auto"]
+MaskModeT = Literal["shared", "per_sample"]
+
+
+@dataclass(frozen=True)
+class JumboViTConfig:
+    """Encoder configuration.
+
+    Capability parity with ``ViTBase`` (``/root/reference/src/modeling.py:35``)
+    plus TPU-first knobs: compute ``dtype`` (bfloat16 by default — MXU-native),
+    ``attn_impl`` selection, and a per-sample masking mode option.
+    """
+
+    layers: int = 12
+    dim: int = 768
+    heads: int = 12
+    num_cls_tokens: int = 3
+    labels: int | None = 1000
+    layerscale: bool = False
+
+    patch_size: int = 16
+    image_size: int = 224
+    posemb: Posemb = "learnable"
+    pooling: Pooling = "cls"
+
+    dropout: float = 0.0
+    droppath: float = 0.0
+    grad_ckpt: bool = False
+
+    # MAE
+    mask_ratio: float | None = None
+    mask_mode: MaskModeT = "shared"
+
+    # classification-head behavior
+    linear_probing: bool = False
+    batch_norm: bool = False
+
+    # TPU-first knobs
+    dtype: str = "bfloat16"  # compute dtype; params always float32
+    attn_impl: AttnImpl = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+    @property
+    def hidden_dim(self) -> int:
+        return 4 * self.dim
+
+    @property
+    def grid(self) -> tuple[int, int]:
+        return (self.image_size // self.patch_size,) * 2
+
+    @property
+    def num_patches(self) -> int:
+        g = self.grid
+        return g[0] * g[1]
+
+    @property
+    def keep_len(self) -> int:
+        if self.mask_ratio is None:
+            raise ValueError("keep_len undefined without mask_ratio")
+        return int(self.num_patches * (1.0 - self.mask_ratio))
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def replace(self, **kw) -> "JumboViTConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    """MAE decoder configuration (parity:
+    ``MAEDecoderBase``, ``/root/reference/src/modeling.py:73-104``).
+    Decoder positional embeddings are always fixed sincos2d — the reference's
+    ``dec_posemb`` flag was parsed but ignored (defect ledger #3), so it does
+    not exist here."""
+
+    layers: int = 8
+    dim: int = 512
+    heads: int = 16
+    layerscale: bool = False
+
+    dropout: float = 0.0
+    droppath: float = 0.0
+    grad_ckpt: bool = False
+
+    dtype: str = "bfloat16"
+    attn_impl: AttnImpl = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+    @property
+    def hidden_dim(self) -> int:
+        return 4 * self.dim
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def replace(self, **kw) -> "DecoderConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Named presets matching the reference recipe matrix (config/*.sh) plus the
+# BASELINE.json north-star ViT-H/14.
+PRESETS: dict[str, dict] = {
+    "vit_t16": dict(layers=2, dim=64, heads=4),  # test-sized
+    "vit_s16": dict(layers=12, dim=384, heads=6),
+    "vit_b16": dict(layers=12, dim=768, heads=12),
+    "vit_l16": dict(layers=24, dim=1024, heads=16),
+    "vit_h14": dict(layers=32, dim=1280, heads=16, patch_size=14),
+}
+
+
+def preset(name: str, **overrides) -> JumboViTConfig:
+    if name not in PRESETS:
+        raise ValueError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return JumboViTConfig(**{**PRESETS[name], **overrides})

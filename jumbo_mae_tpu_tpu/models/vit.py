@@ -1,0 +1,113 @@
+"""The Jumbo ViT encoder.
+
+Parity: ``ViT``, ``/root/reference/src/modeling.py:221-274``. One module
+serves three modes:
+
+- **MAE mode** (``cfg.mask_ratio`` set, ``cfg.labels`` None/0): after patch
+  embedding and CLS prepending, patch tokens are randomly masked and only the
+  visible ones are encoded. Returns ``(tokens, mask, ids_restore)``.
+- **classify mode** (``cfg.labels > 0``): full sequence encoded; the
+  ``num_cls_tokens`` CLS embeddings are concatenated and fed to the linear
+  head. ``cfg.linear_probing`` stops gradients into the trunk;
+  ``cfg.batch_norm`` enables the probe-head BatchNorm.
+- **feature mode** (``cfg.labels`` None and no mask_ratio): returns the
+  normalized token sequence (useful for downstream / conversion tests).
+
+The shared ``jumbo_mlp`` (width k·dim) is built once here and passed to every
+block — the weight sharing is the defining property of the architecture.
+Gradient checkpointing wraps each block with ``nn.remat`` (deterministic flag
+static). The reference's ``pooling`` flag was parsed but ignored
+(defect ledger #3); here ``pooling="gap"`` is actually implemented.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax.linen import initializers as init
+
+from jumbo_mae_tpu_tpu.models.config import JumboViTConfig
+from jumbo_mae_tpu_tpu.models.layers import (
+    ClassifierHead,
+    JumboBlock,
+    Mlp,
+    PatchEmbed,
+)
+from jumbo_mae_tpu_tpu.ops.masking import random_masking
+
+
+class JumboViT(nn.Module):
+    cfg: JumboViTConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.embed = PatchEmbed(cfg, name="embed")
+        self.cls_tokens = self.param(
+            "cls_tokens", init.zeros, (1, cfg.num_cls_tokens, cfg.dim)
+        )
+        self.jumbo_mlp = Mlp(
+            dim=cfg.num_cls_tokens * cfg.dim,
+            hidden_dim=4 * cfg.num_cls_tokens * cfg.dim,
+            dropout=cfg.dropout,
+            dtype=cfg.compute_dtype,
+            name="jumbo_mlp",
+        )
+        block_cls = (
+            nn.remat(JumboBlock, static_argnums=(2,)) if cfg.grad_ckpt else JumboBlock
+        )
+        self.blocks = [
+            block_cls(cfg, self.jumbo_mlp, name=f"block_{i}")
+            for i in range(cfg.layers)
+        ]
+        self.norm = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln")
+        self.drop = nn.Dropout(cfg.dropout)
+        self.head = (
+            ClassifierHead(cfg.labels, cfg.batch_norm, name="head")
+            if (cfg.labels or 0) > 0
+            else None
+        )
+
+    @property
+    def mae_mode(self) -> bool:
+        return self.head is None and self.cfg.mask_ratio is not None
+
+    def __call__(self, images: jax.Array, deterministic: bool = True):
+        cfg = self.cfg
+        k = cfg.num_cls_tokens
+        x = self.embed(images)
+        bs = x.shape[0]
+
+        mask = ids_restore = None
+        if self.mae_mode:
+            x, mask, ids_restore = random_masking(
+                x,
+                self.make_rng("noise"),
+                cfg.keep_len,
+                mode=cfg.mask_mode,
+            )
+
+        cls = jnp.broadcast_to(
+            jnp.asarray(self.cls_tokens, x.dtype), (bs, k, cfg.dim)
+        )
+        x = jnp.concatenate([cls, x], axis=1)
+        x = self.drop(x, deterministic)
+
+        for block in self.blocks:
+            x = block(x, deterministic)
+        x = self.norm(x)
+
+        if self.mae_mode:
+            return x, mask, ids_restore
+
+        if self.head is None:
+            return x
+
+        if cfg.linear_probing:
+            x = jax.lax.stop_gradient(x)
+
+        if cfg.pooling == "gap":
+            pooled = x[:, k:, :].mean(axis=1)
+        else:
+            pooled = x[:, :k, :].reshape(bs, k * cfg.dim)
+        return self.head(pooled.astype(jnp.float32), deterministic)

@@ -7,6 +7,7 @@ from jumbo_mae_tpu_tpu.ops.patches import (
     extract_patches,
     merge_patches,
     patch_mse_loss,
+    patch_mse_loss_per_sample,
 )
 from jumbo_mae_tpu_tpu.ops.posemb import sincos2d_positional_embedding
 
@@ -17,5 +18,6 @@ __all__ = [
     "extract_patches",
     "merge_patches",
     "patch_mse_loss",
+    "patch_mse_loss_per_sample",
     "sincos2d_positional_embedding",
 ]

@@ -1,0 +1,89 @@
+"""Blockwise (memory-efficient) attention in pure XLA.
+
+Online-softmax attention computed chunk-by-chunk over the key axis with
+``lax.scan`` — O(seq) memory instead of the O(seq²) score tensor the
+reference materializes (``/root/reference/src/modeling.py:136-137``). Fully
+differentiable (each chunk rematerialized in the backward pass via
+``jax.checkpoint``), so it also serves as the backward path for the Pallas
+forward kernel and as the per-device compute of ring attention.
+
+Inputs are (batch, seq, heads, head_dim), queries pre-scaled.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    block_k: int = 512,
+    bias: jax.Array | None = None,
+) -> jax.Array:
+    """Softmax(q·kᵀ + bias)·v without materializing the full score matrix.
+
+    ``bias`` (optional) is broadcastable to (batch, heads, seq_q, seq_k) and
+    is sliced along the key axis per chunk.
+    """
+    seq_k = k.shape[1]
+    block_k = min(block_k, seq_k)
+    num_blocks = -(-seq_k // block_k)
+    pad = num_blocks * block_k - seq_k
+    if pad:
+        kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        pad_mask = jnp.arange(num_blocks * block_k) >= seq_k
+    else:
+        kp, vp, pad_mask = k, v, None
+
+    # (blocks, B, block_k, H, D)
+    ks = kp.reshape(kp.shape[0], num_blocks, block_k, *kp.shape[2:]).swapaxes(0, 1)
+    vs = vp.reshape(vp.shape[0], num_blocks, block_k, *vp.shape[2:]).swapaxes(0, 1)
+
+    bq, sq, h, d = q.shape
+    m0 = jnp.full((bq, h, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, h, sq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, sq, h, d), jnp.float32)
+
+    @partial(jax.checkpoint, prevent_cse=False)
+    def chunk(carry, xs):
+        m, l, acc = carry
+        kb, vb, idx = xs
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32
+        )
+        if bias is not None:
+            s = s + jax.lax.dynamic_slice_in_dim(
+                jnp.broadcast_to(bias, (bq, h, sq, seq_k + pad)),
+                idx * block_k,
+                block_k,
+                axis=3,
+            )
+        if pad_mask is not None:
+            sel = jax.lax.dynamic_slice_in_dim(
+                pad_mask, idx * block_k, block_k
+            )
+            s = jnp.where(sel[None, None, None, :], NEG_INF, s)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(-1, keepdims=True)
+        acc = acc * alpha.transpose(0, 2, 1, 3) + jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l, acc), None
+
+    (m, l, acc), _ = jax.lax.scan(
+        chunk, (m0, l0, acc0), (ks, vs, jnp.arange(num_blocks))
+    )
+    out = acc / l.transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)

@@ -1,0 +1,54 @@
+"""Memory-efficient attention for long sequences.
+
+The reference materializes full (B,H,N,N) score tensors
+(``/root/reference/src/modeling.py:136-137``) — fine at N=197, fatal for
+long-context. This module provides ``flash_attention(q, k, v)`` over
+(B, N, H, D) tensors:
+
+- on TPU, a Pallas blockwise-softmax kernel (``pallas_impl``) that never
+  materializes the N×N score matrix in HBM;
+- elsewhere (or for shapes below the kernel's tile granularity), an XLA
+  fallback that is numerically identical to the naive path.
+
+Inputs are expected pre-scaled (queries already multiplied by head_dim**-0.5,
+matching the callers in ``models/layers.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jax.Array:
+    """Softmax(q·kᵀ)·v without materializing the score matrix.
+
+    q, k, v: (batch, seq, heads, head_dim). Returns the same shape as q.
+    """
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    if jax.default_backend() != "tpu" or seq_q % block_q or seq_k % block_k:
+        if max(seq_q, seq_k) >= 2048:
+            from jumbo_mae_tpu_tpu.ops.blockwise_attention import (
+                blockwise_attention,
+            )
+
+            return blockwise_attention(q, k, v, block_k=min(block_k, seq_k))
+        return xla_attention(q, k, v)
+    from jumbo_mae_tpu_tpu.ops.pallas.attention import pallas_flash_attention
+
+    return pallas_flash_attention(q, k, v, block_q, block_k)

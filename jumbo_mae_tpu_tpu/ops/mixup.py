@@ -1,0 +1,78 @@
+"""Mixup and CutMix as pure functions.
+
+Parity: the ``Mixup`` flax module in ``/root/reference/src/utils.py:66-111``
+— Beta-sampled ratio, batch-permutation mixing, CutMix via a computed
+bounding-box mask, and (when both are enabled) computing both and selecting
+one with a coin flip so the program stays branch-free under jit. Implemented
+here as stateless functions of an explicit PRNG key rather than a module with
+an rng stream.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _mixup(key: jax.Array, images: jax.Array, labels: jax.Array, alpha: float):
+    k1, k2 = jax.random.split(key)
+    ratio = jax.random.beta(k1, alpha, alpha)
+    perm = jax.random.permutation(k2, images.shape[0])
+    images = ratio * images + (1 - ratio) * images[perm]
+    labels = ratio * labels + (1 - ratio) * labels[perm]
+    return images, labels
+
+
+def _bounding_box_mask(
+    key: jax.Array, ratio: jax.Array, height: int, width: int
+) -> jax.Array:
+    """1 outside the random box, 0 inside; box area ≈ (1 - ratio)."""
+    size = jnp.sqrt(1 - ratio)
+    cx, cy = jax.random.uniform(key, (2,))
+    xs = jnp.linspace(0, 1, width)
+    ys = jnp.linspace(0, 1, height)
+    in_x = (cx - 0.5 * size <= xs) & (xs < cx + 0.5 * size)
+    in_y = (cy - 0.5 * size <= ys) & (ys < cy + 0.5 * size)
+    inside = in_y[:, None] & in_x[None, :]
+    return (~inside)[None, :, :, None].astype(jnp.float32)
+
+
+def _cutmix(key: jax.Array, images: jax.Array, labels: jax.Array, alpha: float):
+    k1, k2, k3 = jax.random.split(key, 3)
+    ratio = jax.random.beta(k1, alpha, alpha)
+    mask = _bounding_box_mask(k2, ratio, images.shape[1], images.shape[2])
+    label_ratio = mask.mean(axis=(1, 2))
+    perm = jax.random.permutation(k3, images.shape[0])
+    images = mask * images + (1 - mask) * images[perm]
+    labels = label_ratio * labels + (1 - label_ratio) * labels[perm]
+    return images, labels
+
+
+def mixup_cutmix(
+    key: jax.Array,
+    images: jax.Array,
+    labels: jax.Array,
+    mixup_alpha: float = 0.8,
+    cutmix_alpha: float = 1.0,
+):
+    """Apply mixup and/or cutmix to a float image batch and soft labels.
+
+    With both alphas positive, both transforms are computed and one selected
+    per batch by coin flip (branch-free under jit). With both zero this is
+    the identity.
+    """
+    if mixup_alpha == 0 and cutmix_alpha == 0:
+        return images, labels
+    km, kc, kflip = jax.random.split(key, 3)
+    if cutmix_alpha == 0:
+        return _mixup(km, images, labels, mixup_alpha)
+    if mixup_alpha == 0:
+        return _cutmix(kc, images, labels, cutmix_alpha)
+
+    im1, lb1 = _mixup(km, images, labels, mixup_alpha)
+    im2, lb2 = _cutmix(kc, images, labels, cutmix_alpha)
+    take_mixup = jax.random.uniform(kflip) > 0.5
+    return (
+        jnp.where(take_mixup, im1, im2),
+        jnp.where(take_mixup, lb1, lb2),
+    )

@@ -1,0 +1,113 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Grid: (batch·heads, seq_q/block_q). Each program holds one query block in
+VMEM and streams the full key/value sequence for its batch-head through a
+``fori_loop`` of ``block_k`` chunks with the online-softmax recurrence —
+the (seq, seq) score matrix never exists in HBM, scores are accumulated on
+the MXU in float32.
+
+The backward pass is delegated to the differentiable XLA blockwise
+implementation (``ops/blockwise_attention.py``) via ``jax.custom_vjp``:
+residuals are just (q, k, v), recomputed chunkwise — O(seq) memory both ways.
+
+Heads are folded into the batch/grid dimension, so per-program tiles are 2-D
+(block, head_dim) — aligned with the (8/16, 128) sublane×lane tiling as long
+as head_dim is a multiple of 128 (true for every preset: 64-dim heads are
+padded by Mosaic automatically, at some efficiency cost).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int):
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    block_q, d = q.shape
+    seq_k = k_ref.shape[1]
+
+    def body(i, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, seq_k // block_k, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    # fold heads into the grid's batch dim: (B*H, S, D)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def pallas_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over (batch, seq, heads, head_dim); q pre-scaled.
+
+    ``seq_q % block_q == 0`` and ``seq_k % block_k == 0`` are required —
+    callers (``ops/flash_attention.py``) fall back to XLA otherwise.
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
+    """
+    return _flash_fwd(q, k, v, block_q, block_k, interpret)
+
+
+def _vjp_fwd(q, k, v, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, block_q, block_k, interpret), (q, k, v)
+
+
+def _vjp_bwd(block_q, block_k, interpret, residuals, g):
+    from jumbo_mae_tpu_tpu.ops.blockwise_attention import blockwise_attention
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        functools.partial(blockwise_attention, block_k=block_k), q, k, v
+    )
+    return vjp(g)
+
+
+pallas_flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

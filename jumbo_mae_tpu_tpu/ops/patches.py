@@ -31,18 +31,25 @@ def merge_patches(patches: jax.Array, patch_size: int) -> jax.Array:
     return x.reshape(b, g * patch_size, g * patch_size, -1)
 
 
-def patch_mse_loss(
+def patch_mse_loss_per_sample(
     output: jax.Array, target: jax.Array, mask: jax.Array | None = None
 ) -> jax.Array:
-    """Mean-squared error over MASKED patches only.
+    """(B,) mean-squared error over MASKED patches only, per sample.
 
     ``mask`` is (B, N) with 1 at masked positions; the per-sample mean over
     patches is divided by the masked ratio so the result is the mean over
-    masked patches. With ``mask=None`` this degrades to a plain MSE.
+    masked patches. With ``mask=None`` this degrades to a plain per-sample MSE.
     """
     per_patch = jnp.mean(jnp.square(target - output), axis=-1)
     if mask is None:
-        return jnp.mean(per_patch)
+        return jnp.mean(per_patch, axis=-1)
     masked_ratio = jnp.sum(mask, axis=-1) / mask.shape[-1]
     per_sample = jnp.mean(jnp.where(mask > 0.0, per_patch, 0.0), axis=-1)
-    return jnp.mean(per_sample / masked_ratio)
+    return per_sample / masked_ratio
+
+
+def patch_mse_loss(
+    output: jax.Array, target: jax.Array, mask: jax.Array | None = None
+) -> jax.Array:
+    """Scalar batch mean of :func:`patch_mse_loss_per_sample`."""
+    return jnp.mean(patch_mse_loss_per_sample(output, target, mask))

@@ -1,0 +1,33 @@
+"""On-device image normalization.
+
+The input pipeline ships uint8 tensors to the device (4× fewer host→device
+bytes than float32) and normalization happens inside the jitted step — same
+rationale as ``/root/reference/src/pretraining.py:88-91``. This framework's
+native layout is NHWC (TPU-friendly); NCHW input is accepted for parity with
+reference-style loaders and transposed on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize_images(
+    images: jax.Array,
+    dtype=jnp.float32,
+    mean: np.ndarray = IMAGENET_MEAN,
+    std: np.ndarray = IMAGENET_STD,
+) -> jax.Array:
+    """uint8 (B,H,W,C) or (B,C,H,W) → normalized ``dtype`` NHWC."""
+    if images.ndim != 4:
+        raise ValueError(f"expected 4-D image batch, got {images.shape}")
+    if images.shape[1] <= 4 < images.shape[-1]:  # NCHW heuristic: C in {1,3,4}
+        images = jnp.moveaxis(images, 1, 3)
+    x = images.astype(jnp.float32) / 255.0
+    x = (x - mean) / std
+    return x.astype(dtype)

@@ -1,0 +1,15 @@
+from jumbo_mae_tpu_tpu.parallel.mesh import MeshConfig, create_mesh
+from jumbo_mae_tpu_tpu.parallel.sharding import (
+    batch_sharding,
+    infer_state_sharding,
+    shard_param_spec,
+)
+
+__all__ = [
+    "MeshConfig",
+    "create_mesh",
+    "batch_sharding",
+    "infer_state_sharding",
+    "shard_param_spec",
+]
+

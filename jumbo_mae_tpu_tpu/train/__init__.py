@@ -1,0 +1,17 @@
+from jumbo_mae_tpu_tpu.train.optim import OptimConfig, make_optimizer, make_schedule
+from jumbo_mae_tpu_tpu.train.state import TrainState
+from jumbo_mae_tpu_tpu.train.steps import (
+    create_sharded_state,
+    make_eval_step,
+    make_train_step,
+)
+
+__all__ = [
+    "OptimConfig",
+    "make_optimizer",
+    "make_schedule",
+    "TrainState",
+    "create_sharded_state",
+    "make_eval_step",
+    "make_train_step",
+]

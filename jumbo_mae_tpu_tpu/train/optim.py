@@ -1,0 +1,146 @@
+"""Optimizers, schedules, layer-wise LR decay.
+
+Parity targets:
+
+- optimizer set {adamw, lamb(modified), lars, sgd} with the reference's
+  hyperparameter wiring (``/root/reference/src/pretraining.py:223-259``,
+  ``/root/reference/src/finetuning.py:218-265``);
+- modified LAMB: adam scaling → decoupled weight decay → trust ratio applied
+  ONLY to weight-decayed (kernel) params (``/root/reference/src/utils.py:124-139``);
+- weight-decay mask = parameters literally named "kernel";
+- layer-wise LR decay via ``optax.multi_transform`` keyed by encoder depth
+  (``/root/reference/src/utils.py:142-147``);
+- warmup+cosine schedule (init 1e-6 → peak → end), MAE linear LR scaling
+  peak = lr · global_batch/256;
+- live LR exposed through ``optax.inject_hyperparams`` for logging.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from functools import partial
+from typing import Literal
+
+import optax
+from jax.tree_util import tree_map_with_path
+
+OptimizerName = Literal["adamw", "lamb", "lars", "sgd"]
+LrScaling = Literal["batch", "none"]
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    name: OptimizerName = "adamw"
+    learning_rate: float = 1.5e-4  # base LR (pre-scaling)
+    lr_scaling: LrScaling = "batch"
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.05
+    momentum: float = 0.9
+    clip_grad: float = 0.0
+    layer_decay: float = 1.0  # <1 enables layer-wise decay
+    warmup_steps: int = 0
+    training_steps: int = 1
+    init_lr: float = 1e-6
+    end_lr: float = 1e-5
+
+    def peak_lr(self, global_batch_size: int) -> float:
+        if self.lr_scaling == "batch":
+            return self.learning_rate * global_batch_size / 256
+        return self.learning_rate
+
+
+def kernel_mask(params):
+    """True for every param whose final path key is "kernel"."""
+    return tree_map_with_path(lambda kp, _: kp[-1].key == "kernel", params)
+
+
+def layer_index(path, _unused=None, *, num_layers: int) -> int:
+    """Param path → encoder depth for layer-wise LR decay.
+
+    Layout-specific to this framework's trees: the encoder lives under a
+    top-level "model" (finetune) with blocks named ``block_i``. embed → 0,
+    block_i → i+1, everything else (head, final norm, cls_tokens,
+    jumbo_mlp) → num_layers.
+    """
+    keys = [getattr(k, "key", str(k)) for k in path]
+    if keys and keys[0] == "model":
+        if len(keys) > 1 and keys[1] == "embed":
+            return 0
+        if len(keys) > 1 and (m := re.fullmatch(r"block_(\d+)", keys[1])):
+            return int(m.group(1)) + 1
+    return num_layers
+
+
+def make_schedule(cfg: OptimConfig, global_batch_size: int) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=cfg.init_lr,
+        peak_value=cfg.peak_lr(global_batch_size),
+        warmup_steps=cfg.warmup_steps,
+        decay_steps=cfg.training_steps,
+        end_value=cfg.end_lr,
+    )
+
+
+def modified_lamb(
+    learning_rate, b1, b2, eps, weight_decay, mask
+) -> optax.GradientTransformation:
+    """LAMB with the trust ratio restricted to weight-decayed params."""
+    return optax.chain(
+        optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+        optax.add_decayed_weights(weight_decay=weight_decay, mask=mask),
+        optax.masked(optax.scale_by_trust_ratio(), mask=mask),
+        optax.scale_by_learning_rate(learning_rate),
+    )
+
+
+def make_optimizer(
+    cfg: OptimConfig,
+    global_batch_size: int,
+    *,
+    num_layers: int | None = None,
+) -> optax.GradientTransformation:
+    """Build the full transformation chain, LR exposed in
+    ``opt_state.hyperparams["learning_rate"]``."""
+
+    @optax.inject_hyperparams
+    def build(learning_rate):
+        wd_mask = kernel_mask
+        if cfg.name == "adamw":
+            tx = optax.adamw(
+                learning_rate,
+                b1=cfg.b1,
+                b2=cfg.b2,
+                eps=cfg.eps,
+                weight_decay=cfg.weight_decay,
+                mask=wd_mask,
+            )
+        elif cfg.name == "lamb":
+            tx = modified_lamb(
+                learning_rate, cfg.b1, cfg.b2, cfg.eps, cfg.weight_decay, wd_mask
+            )
+        elif cfg.name == "lars":
+            tx = optax.lars(learning_rate, momentum=cfg.momentum)
+        elif cfg.name == "sgd":
+            tx = optax.sgd(learning_rate, momentum=cfg.momentum)
+        else:
+            raise ValueError(f"unknown optimizer {cfg.name!r}")
+
+        if cfg.layer_decay < 1.0:
+            if num_layers is None:
+                raise ValueError("layer_decay requires num_layers")
+            scales = {
+                i: optax.scale(cfg.layer_decay ** (num_layers - i))
+                for i in range(num_layers + 1)
+            }
+            label_fn = partial(
+                tree_map_with_path, partial(layer_index, num_layers=num_layers)
+            )
+            tx = optax.chain(tx, optax.multi_transform(scales, label_fn))
+        if cfg.clip_grad > 0:
+            tx = optax.chain(optax.clip_by_global_norm(cfg.clip_grad), tx)
+        return tx
+
+    return build(make_schedule(cfg, global_batch_size))

@@ -1,0 +1,242 @@
+"""jit + GSPMD train/eval steps and sharded state creation.
+
+This is the runtime the reference delegated to ``jax.pmap``
+(``/root/reference/src/pretraining.py:125-167``,
+``/root/reference/src/finetuning.py:109-165``), rebuilt mesh-native:
+
+- ONE ``jax.jit`` program per step over an explicit mesh; the batch is
+  sharded over (data, fsdp), parameters/optimizer state over fsdp (ZeRO-3
+  rule in ``parallel/sharding.py``). GSPMD inserts the gradient
+  reduce-scatter/all-gather the reference expressed as ``lax.pmean``.
+- Gradient accumulation is a ``lax.scan`` over a leading micro-batch axis
+  *inside* the step — one device dispatch per optimizer update — instead of
+  the reference's host-visible micro-step counter + ``lax.cond`` state
+  machine.
+- Metrics come back as global scalars (the mean over a globally-sharded
+  batch IS the cross-replica mean; no explicit collective needed).
+- Eval aggregates per-sample metrics against an explicit ``valid`` mask,
+  fixing the reference's mis-normalized pretrain val loss
+  (``/root/reference/src/main_pretrain.py:43-45``, SURVEY defect #2) and its
+  count-the-padding ``num_samples`` quirk.
+
+State creation initializes parameters *already sharded* via
+``jax.jit(init, out_shardings=...)`` — no host-resident full copy, which is
+what makes ViT-H-scale FSDP init feasible on small hosts.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Literal
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from jumbo_mae_tpu_tpu.parallel.sharding import (
+    batch_sharding,
+    infer_state_sharding,
+)
+from jumbo_mae_tpu_tpu.train.state import STREAMS, TrainState, make_base_rng
+
+Mode = Literal["pretrain", "classify"]
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_scale(a, s):
+    return jax.tree_util.tree_map(lambda x: x * s, a)
+
+
+def _model_inputs(mode: Mode, batch: dict) -> tuple:
+    if mode == "pretrain":
+        return (batch["images"],)
+    return (batch["images"], batch["labels"])
+
+
+def create_sharded_state(
+    module,
+    tx: optax.GradientTransformation,
+    example_batch: dict,
+    mesh: Mesh,
+    *,
+    mode: Mode,
+    init_seed: int = 0,
+    rng_seed: int = 0,
+    min_shard_size: int = 2**16,
+) -> tuple[TrainState, Any]:
+    """Initialize a TrainState directly into its mesh sharding.
+
+    Returns ``(state, state_sharding)``; the sharding tree is reused by the
+    step factories and the checkpoint manager.
+    """
+    inputs = _model_inputs(mode, example_batch)
+    init_rngs = {
+        "params": jax.random.key(init_seed),
+        **{
+            name: jax.random.fold_in(jax.random.key(init_seed), sid + 1)
+            for name, sid in STREAMS.items()
+        },
+    }
+
+    def init_fn():
+        variables = module.init(init_rngs, *inputs)
+        return TrainState.create(
+            apply_fn=module.apply,
+            params=variables["params"],
+            tx=tx,
+            batch_stats=variables.get("batch_stats"),
+            rng=make_base_rng(rng_seed),
+        )
+
+    shapes = jax.eval_shape(init_fn)
+    sharding = infer_state_sharding(shapes, mesh, min_shard_size=min_shard_size)
+    state = jax.jit(init_fn, out_shardings=sharding)()
+    return state, sharding
+
+
+def make_train_step(
+    mesh: Mesh,
+    state_sharding: Any,
+    *,
+    mode: Mode,
+    grad_accum: int = 1,
+) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
+    """Build the jitted train step.
+
+    ``grad_accum == 1``: batch leaves are (batch, ...).
+    ``grad_accum > 1``: batch leaves are (accum, micro, ...) and a
+    ``lax.scan`` accumulates gradients before the single optimizer update.
+    """
+
+    def loss_fn(params, batch_stats, micro_idx, batch, state):
+        rngs = state.step_rngs(micro=micro_idx)
+        variables = {"params": params}
+        new_stats = None
+        if batch_stats is not None:
+            variables["batch_stats"] = batch_stats
+            out, updated = state.apply_fn(
+                variables,
+                *_model_inputs(mode, batch),
+                deterministic=False,
+                rngs=rngs,
+                mutable=["batch_stats"],
+            )
+            new_stats = updated["batch_stats"]
+        else:
+            out = state.apply_fn(
+                variables,
+                *_model_inputs(mode, batch),
+                deterministic=False,
+                rngs=rngs,
+            )
+        metrics = {
+            k: v.mean() if v.ndim else v
+            for k, v in out.items()
+            if not k.endswith("_per_sample")
+        }
+        return metrics["loss"], (metrics, new_stats)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    @partial(
+        jax.jit,
+        donate_argnums=(0,),
+        in_shardings=(state_sharding, batch_sharding(mesh, accum=grad_accum > 1)),
+        out_shardings=(state_sharding, None),
+    )
+    def train_step(state: TrainState, batch: dict):
+        if grad_accum == 1:
+            (_, (metrics, new_stats)), grads = grad_fn(
+                state.params, state.batch_stats, 0, batch, state
+            )
+        else:
+            metrics_shape = jax.eval_shape(
+                lambda: loss_fn(
+                    state.params,
+                    state.batch_stats,
+                    0,
+                    jax.tree_util.tree_map(lambda x: x[0], batch),
+                    state,
+                )[1][0]
+            )
+            init = (
+                jax.tree_util.tree_map(jnp.zeros_like, state.params),
+                jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
+                ),
+                state.batch_stats,
+            )
+
+            def micro(carry, xs):
+                grads_acc, metrics_acc, stats = carry
+                idx, micro_batch = xs
+                (_, (metrics, new_stats)), grads = grad_fn(
+                    state.params, stats, idx, micro_batch, state
+                )
+                return (
+                    _tree_add(grads_acc, grads),
+                    _tree_add(metrics_acc, metrics),
+                    new_stats if new_stats is not None else stats,
+                ), None
+
+            (grads, metrics, new_stats), _ = jax.lax.scan(
+                micro, init, (jnp.arange(grad_accum), batch)
+            )
+            grads = _tree_scale(grads, 1.0 / grad_accum)
+            metrics = _tree_scale(metrics, 1.0 / grad_accum)
+
+        state = state.apply_gradients(grads=grads)
+        if new_stats is not None:
+            state = state.replace(batch_stats=new_stats)
+        hyper = getattr(state.opt_state, "hyperparams", None)
+        if hyper is not None:
+            metrics = metrics | {"learning_rate": hyper["learning_rate"]}
+        return state, metrics
+
+    return train_step
+
+
+def make_eval_step(
+    mesh: Mesh, state_sharding: Any, *, mode: Mode
+) -> Callable[[TrainState, dict], dict]:
+    """Jitted eval step returning SUMS over valid samples + the valid count;
+    the host-side loop divides at the end (exact weighted mean even with
+    ragged final batches)."""
+
+    @partial(
+        jax.jit,
+        in_shardings=(state_sharding, batch_sharding(mesh, accum=False)),
+        out_shardings=None,
+    )
+    def eval_step(state: TrainState, batch: dict):
+        rngs = state.step_rngs(micro=STREAMS["eval"])
+        variables = {"params": state.params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        valid = batch.get("valid")
+        if valid is None:
+            valid = jnp.ones(batch["images"].shape[0], jnp.float32)
+        else:
+            valid = valid.astype(jnp.float32)
+
+        if mode == "pretrain":
+            out = state.apply_fn(
+                variables, batch["images"], deterministic=True, rngs=rngs
+            )
+            per_sample = {"loss": out["loss_per_sample"]}
+        else:
+            labels = jnp.where(batch["labels"] >= 0, batch["labels"], 0)
+            out = state.apply_fn(
+                variables, batch["images"], labels, deterministic=True
+            )
+            per_sample = {k: out[k] for k in ("loss", "acc1", "acc5")}
+
+        sums = {k: jnp.sum(v * valid) for k, v in per_sample.items()}
+        sums["num_samples"] = valid.sum()
+        return sums
+
+    return eval_step

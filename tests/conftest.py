@@ -1,19 +1,24 @@
 """Test harness: run everything on a virtual 8-device CPU mesh.
 
-Must set the environment before the first ``import jax`` anywhere in the test
-process — conftest import time is the earliest reliable hook pytest gives us.
+The surrounding environment registers a remote-TPU ("axon") PJRT plugin via a
+``sitecustomize.py`` that imports jax at interpreter start with
+``JAX_PLATFORMS=axon`` — so mutating ``os.environ`` here is too late (the
+config default was already captured). ``jax.config.update`` works as long as
+no backend has been *initialized* yet, which holds at conftest import time.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
+import jax  # noqa: E402  (may already be imported by sitecustomize)
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
