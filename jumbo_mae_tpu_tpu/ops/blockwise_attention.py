@@ -41,6 +41,13 @@ def blockwise_attention(
         kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
         pad_mask = jnp.arange(num_blocks * block_k) >= seq_k
+        if bias is not None and bias.shape[-1] == seq_k:
+            # keep the key axis broadcastable after padding; padded keys are
+            # killed by pad_mask anyway, so the fill value is irrelevant
+            bias = jnp.pad(
+                bias, [(0, 0)] * (bias.ndim - 1) + [(0, pad)],
+                constant_values=NEG_INF,
+            )
     else:
         kp, vp, pad_mask = k, v, None
 

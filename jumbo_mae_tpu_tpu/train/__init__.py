@@ -1,3 +1,10 @@
+from jumbo_mae_tpu_tpu.train.checkpoint import (
+    CheckpointConfig,
+    Checkpointer,
+    export_params_msgpack,
+    import_params_msgpack,
+    load_pretrained_params,
+)
 from jumbo_mae_tpu_tpu.train.optim import OptimConfig, make_optimizer, make_schedule
 from jumbo_mae_tpu_tpu.train.state import TrainState
 from jumbo_mae_tpu_tpu.train.steps import (
@@ -7,6 +14,11 @@ from jumbo_mae_tpu_tpu.train.steps import (
 )
 
 __all__ = [
+    "CheckpointConfig",
+    "Checkpointer",
+    "export_params_msgpack",
+    "import_params_msgpack",
+    "load_pretrained_params",
     "OptimConfig",
     "make_optimizer",
     "make_schedule",

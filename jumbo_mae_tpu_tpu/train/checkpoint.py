@@ -1,0 +1,443 @@
+"""Checkpointing: Orbax full-state async save/restore with true resume.
+
+The reference persisted *params only*, via a fire-and-forget msgpack thread
+(``/root/reference/src/utils.py:55-63``) — no optimizer state, no RNG, no step
+counter, so a restart silently lost Adam moments and schedule position
+(SURVEY §5 "no true resume", defect #6 un-joined writer thread). This module
+is the TPU-native replacement:
+
+- **Full state**: params + optimizer state + BatchNorm stats + base RNG +
+  step counter, saved with Orbax (async by default, multi-host aware,
+  sharding-preserving) — restart == continue.
+- **best/last policy**: ``last/`` keeps a rolling window; ``best/`` keeps the
+  single best checkpoint by a chosen metric (min val loss for pretrain, max
+  val acc1 for finetune — parity with
+  ``/root/reference/src/main_pretrain.py:88-90`` /
+  ``src/main_finetune.py:88-90``).
+- **Warm start**: :func:`load_pretrained_params` merges a pretrained encoder
+  into a fresh param tree with key-overlap diagnostics and *working*
+  positional-embedding resize (the reference shipped this commented out,
+  ``/root/reference/src/utils.py:160-200``, defect #5).
+- **Interop**: msgpack export/import for reference-style params files, with a
+  joined background-writer registry (no truncation on exit).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import orbax.checkpoint as ocp
+from flax import serialization
+
+# --------------------------------------------------------------------------
+# RNG-key plumbing: typed PRNG keys are stored as their uint32 key data.
+# --------------------------------------------------------------------------
+
+
+def _is_typed_key(x) -> bool:
+    return isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+
+
+def split_rng_for_save(state):
+    """Return (state_without_rng_types, rng_key_data or None)."""
+    rng = getattr(state, "rng", None)
+    if rng is not None and _is_typed_key(rng):
+        return state.replace(rng=jax.random.key_data(rng)), True
+    return state, False
+
+
+def rejoin_rng(state, was_typed: bool):
+    if was_typed and state.rng is not None and not _is_typed_key(state.rng):
+        return state.replace(rng=jax.random.wrap_key_data(state.rng))
+    return state
+
+
+def abstract_state(state_or_shapes, sharding: Any = None):
+    """ShapeDtypeStruct tree (rng as key-data) for Orbax restore, with
+    shardings attached when given so arrays restore directly into the mesh."""
+    shapes = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            jax.random.key_data(x).shape
+            if _is_typed_key(x)
+            else x.shape,
+            jnp.uint32 if _is_typed_key(x) else x.dtype,
+        ),
+        state_or_shapes,
+    )
+    if sharding is None:
+        return shapes
+    return jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes,
+        sharding,
+    )
+
+
+# --------------------------------------------------------------------------
+# Checkpointer: best/last full-state policy over two Orbax managers
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    directory: str
+    max_keep_last: int = 2
+    async_save: bool = True
+    best_mode: str = "min"  # "min" (val loss) or "max" (val acc)
+    metric_key: str = "val/loss"
+
+
+class Checkpointer:
+    """Full-train-state checkpoint manager with a best/last policy.
+
+    ``save(step, state, metrics)`` always updates ``last/`` and additionally
+    ``best/`` when ``metrics[metric_key]`` improves. ``restore`` rebuilds the
+    state *into its mesh sharding* from a template. ``extra`` carries
+    host-side state (data-iterator cursor, config echo) as JSON.
+    """
+
+    def __init__(self, cfg: CheckpointConfig):
+        self.cfg = cfg
+        root = Path(cfg.directory)
+        opts = dict(enable_async_checkpointing=cfg.async_save)
+        self._last = ocp.CheckpointManager(
+            (root / "last").absolute(),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=cfg.max_keep_last, **opts
+            ),
+        )
+        self._best = ocp.CheckpointManager(
+            (root / "best").absolute(),
+            options=ocp.CheckpointManagerOptions(max_to_keep=1, **opts),
+        )
+        self._best_metric = self._read_best_metric()
+
+    def _read_best_metric(self) -> float | None:
+        step = self._best.latest_step()
+        if step is None:
+            return None
+        try:
+            meta = self._best.restore(
+                step, args=ocp.args.Composite(extra=ocp.args.JsonRestore())
+            )["extra"]
+            return meta.get("_best_metric")
+        except Exception:
+            return None
+
+    @property
+    def best_metric(self) -> float | None:
+        return self._best_metric
+
+    def _improved(self, value: float) -> bool:
+        if self._best_metric is None:
+            return True
+        if self.cfg.best_mode == "min":
+            return value < self._best_metric
+        return value > self._best_metric
+
+    def save(
+        self,
+        step: int,
+        state,
+        metrics: dict[str, float] | None = None,
+        extra: dict[str, Any] | None = None,
+    ) -> bool:
+        """Save ``last``; promote to ``best`` on metric improvement.
+        Returns True if this step became the new best."""
+        extra = dict(extra or {})
+        state, was_typed = split_rng_for_save(state)
+        extra["_rng_typed"] = was_typed
+        args = ocp.args.Composite(
+            state=ocp.args.StandardSave(state),
+            extra=ocp.args.JsonSave(extra),
+        )
+        self._last.save(step, args=args)
+        value = None if metrics is None else metrics.get(self.cfg.metric_key)
+        is_best = value is not None and self._improved(float(value))
+        if is_best:
+            self._best_metric = float(value)
+            best_extra = extra | {"_best_metric": self._best_metric}
+            self._best.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    extra=ocp.args.JsonSave(best_extra),
+                ),
+            )
+        return is_best
+
+    def latest_step(self) -> int | None:
+        return self._last.latest_step()
+
+    def restore(
+        self,
+        template,
+        *,
+        sharding: Any = None,
+        step: int | None = None,
+        which: str = "last",
+    ):
+        """Restore ``(state, extra)``. ``template`` is a live state or
+        eval_shape tree defining structure/dtypes; ``sharding`` (same tree of
+        NamedShardings) places arrays directly on the mesh."""
+        mgr = self._last if which == "last" else self._best
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no '{which}' checkpoint under {self.cfg.directory}"
+            )
+        tmpl, _ = split_rng_for_save(template)
+        abstract = abstract_state(tmpl, sharding)
+        out = mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                extra=ocp.args.JsonRestore(),
+            ),
+        )
+        extra = out["extra"] or {}
+        state = rejoin_rng(out["state"], extra.get("_rng_typed", False))
+        return state, extra
+
+    def wait(self):
+        self._last.wait_until_finished()
+        self._best.wait_until_finished()
+
+    def close(self):
+        self.wait()
+        self._last.close()
+        self._best.close()
+
+
+# --------------------------------------------------------------------------
+# Warm start: pretrained-encoder merge with diagnostics + posemb resize
+# --------------------------------------------------------------------------
+
+
+def _flatten(tree, prefix=()):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, prefix + (k,)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def _unflatten(flat):
+    tree: dict = {}
+    for path, v in flat.items():
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = v
+    return tree
+
+
+def resize_posemb(posemb: np.ndarray, target_shape: tuple[int, ...]) -> np.ndarray:
+    """Bilinearly resize an (H, W, D) or (1, H, W, D) positional-embedding
+    grid to a new grid size (image-size / patch-size change between pretrain
+    and finetune). The reference's equivalent surgery was commented out
+    (``/root/reference/src/utils.py:168-179``); here it works. This
+    framework's learnable posemb is the 3-D ``pos_embed`` grid
+    (``models/layers.py``)."""
+    if posemb.shape == tuple(target_shape):
+        return posemb
+    if posemb.ndim != len(target_shape) or posemb.ndim not in (3, 4):
+        raise ValueError(
+            f"posemb resize expects (H,W,D) or (1,H,W,D) grids, got "
+            f"{posemb.shape} → {target_shape}"
+        )
+    hw = slice(1, 3) if posemb.ndim == 4 else slice(0, 2)
+    out_shape = list(posemb.shape)
+    out_shape[hw] = list(target_shape[hw])
+    resized = jax.image.resize(
+        jnp.asarray(posemb, jnp.float32), out_shape, method="bilinear"
+    )
+    return np.asarray(resized, dtype=posemb.dtype)
+
+
+def merge_pretrained_params(
+    pretrained: dict,
+    init_params: dict,
+    *,
+    verbose: bool = True,
+) -> dict:
+    """Merge ``pretrained`` into ``init_params`` by key path.
+
+    - matching path + shape → pretrained value;
+    - posemb grids with mismatched H/W → bilinear resize;
+    - other shape mismatches (e.g. a head for a different label count) →
+      keep the fresh init;
+    - paths only in ``init_params`` (decoder dropped, new head) → fresh init.
+
+    Prints the overlap diagnostics the reference printed
+    (``/root/reference/src/utils.py:154-158``).
+    """
+    src = _flatten(pretrained)
+    dst = _flatten(init_params)
+    merged, loaded, resized, skipped = {}, [], [], []
+    for path, init_val in dst.items():
+        if path not in src:
+            merged[path] = init_val
+            continue
+        val = src[path]
+        if tuple(np.shape(val)) == tuple(np.shape(init_val)):
+            merged[path] = jnp.asarray(val, init_val.dtype)
+            loaded.append(path)
+        elif path[-1] in ("pos_embed", "posemb", "wpe") and np.ndim(val) in (3, 4):
+            merged[path] = jnp.asarray(
+                resize_posemb(np.asarray(val), np.shape(init_val)),
+                init_val.dtype,
+            )
+            resized.append(path)
+        else:
+            merged[path] = init_val
+            skipped.append(path)
+    unused = [p for p in src if p not in dst]
+    if verbose:
+        def fmt(paths):
+            return sorted("/".join(p) for p in paths)
+
+        print(
+            f"[checkpoint] pretrained merge: {len(loaded)} loaded, "
+            f"{len(resized)} resized, {len(skipped)} shape-mismatch (fresh), "
+            f"{len(unused)} unused"
+        )
+        for name, paths in (("resized", resized), ("fresh", skipped)):
+            for p in fmt(paths):
+                print(f"[checkpoint]   {name}: {p}")
+        for p in fmt(unused)[:20]:
+            print(f"[checkpoint]   unused: {p}")
+    return _unflatten(merged)
+
+
+# the encoder lives under "encoder" in MAEPretrainModel trees and "model"
+# in ClassificationModel trees; warm starts cross that boundary.
+_ENCODER_KEYS = ("encoder", "model")
+
+
+def load_pretrained_params(
+    path: str,
+    init_params: dict,
+    *,
+    subtree: str | None = "auto",
+    verbose: bool = True,
+) -> dict:
+    """Load pretrained params from an Orbax checkpoint dir or a ``.msgpack``
+    file and merge into ``init_params`` (parity:
+    ``/root/reference/src/utils.py:150-202``, with the surgery un-commented).
+
+    ``subtree="auto"``: the encoder subtree is located on both sides
+    (``encoder`` for pretrain trees, ``model`` for classification trees) and
+    merged across the rename — a pretrain checkpoint's decoder params are
+    dropped for finetune. Pass an explicit key or ``None`` for whole-tree
+    merge.
+    """
+    p = Path(path)
+    if p.is_dir():
+        tree = restore_params_any(p)
+    else:
+        tree = import_params_msgpack(p)
+    tree = serialization.to_state_dict(tree)
+    init_sd = serialization.to_state_dict(init_params)
+
+    def find_encoder(sd):
+        for k in _ENCODER_KEYS:
+            if k in sd:
+                return k
+        return None
+
+    if subtree == "auto":
+        src_key, dst_key = find_encoder(tree), find_encoder(init_sd)
+    else:
+        src_key = dst_key = subtree
+
+    if src_key is not None and dst_key is not None:
+        merged = dict(init_sd)
+        merged[dst_key] = merge_pretrained_params(
+            tree[src_key], init_sd[dst_key], verbose=verbose
+        )
+    else:
+        merged = merge_pretrained_params(tree, init_sd, verbose=verbose)
+    return serialization.from_state_dict(init_params, merged)
+
+
+def restore_params_any(directory: Path) -> dict:
+    """Restore just the params tree from a Checkpointer layout (best/ or
+    last/ subdirs, or a direct manager dir)."""
+    directory = Path(directory)
+    for sub in ("best", "last", "."):
+        root = (directory / sub).resolve()
+        if root.is_dir():
+            with ocp.CheckpointManager(root) as mgr:
+                step = mgr.latest_step()
+                if step is None:
+                    continue
+                out = mgr.restore(
+                    step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+                )
+                state = out["state"]
+                params = (
+                    state.get("params") if isinstance(state, dict) else state.params
+                )
+                if params is not None:
+                    return params
+    raise FileNotFoundError(f"no restorable checkpoint under {directory}")
+
+
+# --------------------------------------------------------------------------
+# msgpack interop (+ joined background writer — defect #6 fixed)
+# --------------------------------------------------------------------------
+
+_background_writers: list[threading.Thread] = []
+
+
+def export_params_msgpack(params, path: str, *, background: bool = False):
+    """Write a reference-compatible params msgpack. With ``background=True``
+    the write happens on a tracked thread that is joined at interpreter exit
+    (the reference's thread was fire-and-forget → truncation risk,
+    ``/root/reference/src/utils.py:58-63``)."""
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    payload = serialization.msgpack_serialize(
+        serialization.to_state_dict(host_params)
+    )
+
+    def write():
+        target = Path(path)
+        target.parent.mkdir(parents=True, exist_ok=True)
+        tmp = target.with_suffix(target.suffix + ".tmp")
+        tmp.write_bytes(payload)
+        tmp.replace(target)  # atomic: readers never see a partial file
+
+    if background:
+        t = threading.Thread(target=write, daemon=False)
+        t.start()
+        _background_writers.append(t)
+    else:
+        write()
+
+
+def import_params_msgpack(path: str) -> dict:
+    return serialization.msgpack_restore(Path(path).read_bytes())
+
+
+@atexit.register
+def _join_background_writers():
+    for t in _background_writers:
+        t.join()
+
+
+def save_metadata_json(directory: str, payload: dict):
+    p = Path(directory)
+    p.mkdir(parents=True, exist_ok=True)
+    (p / "metadata.json").write_text(json.dumps(payload, indent=2, default=str))

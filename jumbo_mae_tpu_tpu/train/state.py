@@ -19,7 +19,12 @@ from flax import struct
 from flax.training import train_state
 
 # Stable stream ids for fold_in derivation.
-STREAMS = {"dropout": 0, "noise": 1, "mixup": 2, "eval": 3}
+STREAMS = {"dropout": 0, "noise": 1, "mixup": 2}
+
+# Domain separators so train and eval derivations can never collide even at
+# the same (step, micro) coordinates.
+TRAIN_DOMAIN = 0
+EVAL_DOMAIN = 1
 
 
 class TrainState(train_state.TrainState):
@@ -28,9 +33,12 @@ class TrainState(train_state.TrainState):
     batch_stats: Any = None
     rng: jax.Array = struct.field(default=None)
 
-    def step_rngs(self, *, micro: jax.Array | int = 0) -> dict[str, jax.Array]:
+    def step_rngs(
+        self, *, micro: jax.Array | int = 0, domain: int = TRAIN_DOMAIN
+    ) -> dict[str, jax.Array]:
         """Per-step, per-microbatch named rng streams."""
         base = jax.random.fold_in(self.rng, self.step)
+        base = jax.random.fold_in(base, domain)
         base = jax.random.fold_in(base, micro)
         return {
             name: jax.random.fold_in(base, sid) for name, sid in STREAMS.items()

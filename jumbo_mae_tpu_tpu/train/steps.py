@@ -38,7 +38,12 @@ from jumbo_mae_tpu_tpu.parallel.sharding import (
     batch_sharding,
     infer_state_sharding,
 )
-from jumbo_mae_tpu_tpu.train.state import STREAMS, TrainState, make_base_rng
+from jumbo_mae_tpu_tpu.train.state import (
+    EVAL_DOMAIN,
+    STREAMS,
+    TrainState,
+    make_base_rng,
+)
 
 Mode = Literal["pretrain", "classify"]
 
@@ -205,15 +210,17 @@ def make_eval_step(
 ) -> Callable[[TrainState, dict], dict]:
     """Jitted eval step returning SUMS over valid samples + the valid count;
     the host-side loop divides at the end (exact weighted mean even with
-    ragged final batches)."""
+    ragged final batches). ``batch_idx`` varies the eval RNG (MAE masking)
+    across the eval loop's batches; derivation is domain-separated from
+    training so no (step, micro) coordinate can collide."""
 
     @partial(
         jax.jit,
-        in_shardings=(state_sharding, batch_sharding(mesh, accum=False)),
+        in_shardings=(state_sharding, batch_sharding(mesh, accum=False), None),
         out_shardings=None,
     )
-    def eval_step(state: TrainState, batch: dict):
-        rngs = state.step_rngs(micro=STREAMS["eval"])
+    def _eval_step(state: TrainState, batch: dict, batch_idx):
+        rngs = state.step_rngs(micro=batch_idx, domain=EVAL_DOMAIN)
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
@@ -238,5 +245,8 @@ def make_eval_step(
         sums = {k: jnp.sum(v * valid) for k, v in per_sample.items()}
         sums["num_samples"] = valid.sum()
         return sums
+
+    def eval_step(state: TrainState, batch: dict, batch_idx: int = 0):
+        return _eval_step(state, batch, jnp.asarray(batch_idx, jnp.int32))
 
     return eval_step

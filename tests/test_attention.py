@@ -55,6 +55,16 @@ class TestBlockwise:
         got = blockwise_attention(q, k, v, block_k=16, bias=bias)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
 
+    def test_bias_with_padding(self):
+        # full-length key axis bias + seq_k not divisible by block_k
+        q, k, v = qkv(s=100)
+        bias = jax.random.normal(jax.random.key(8), (1, 1, 100, 100))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) + bias
+        probs = jax.nn.softmax(logits, -1)
+        ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        got = blockwise_attention(q, k, v, block_k=64, bias=bias)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
 
 class TestPallasKernel:
     @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
