@@ -1,0 +1,352 @@
+"""Unified training entry point: pretrain / finetune / linear probe.
+
+The reference shipped two near-identical entry scripts
+(``/root/reference/src/main_pretrain.py:48-96``,
+``/root/reference/src/main_finetune.py:48-96``) driven by bash flag files;
+here one loop covers all three modes, driven by YAML recipes
+(``recipes/``). Structure parity with the reference loop: sanity eval before
+step 1, step loop with metric meters, periodic eval + best/last
+checkpointing — plus what it lacked: true resume, MFU/throughput reporting,
+deterministic seeds, profiler capture.
+
+Run:
+    python -m jumbo_mae_tpu_tpu.cli.train --config recipes/pretrain_vit_b16_in1k_1600ep.yaml
+    python -m jumbo_mae_tpu_tpu.cli.train --config ... --set run.training_steps=10 data.workers=0
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from jumbo_mae_tpu_tpu.config import TrainConfig, config_to_dict, load_config
+from jumbo_mae_tpu_tpu.data import (
+    DataConfig,
+    TrainLoader,
+    prefetch_to_device,
+    split_for_accum,
+    synthetic_batches,
+    valid_loader,
+)
+from jumbo_mae_tpu_tpu.models import (
+    ClassificationModel,
+    DecoderConfig,
+    MAEPretrainModel,
+    preset,
+)
+from jumbo_mae_tpu_tpu.parallel import batch_sharding, create_mesh
+from jumbo_mae_tpu_tpu.train import (
+    Checkpointer,
+    create_sharded_state,
+    load_pretrained_params,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+)
+from jumbo_mae_tpu_tpu.utils import (
+    AverageMeter,
+    MetricLogger,
+    StepTimer,
+    classify_flops_per_image,
+    mfu_report,
+    pretrain_flops_per_image,
+)
+from jumbo_mae_tpu_tpu.utils.profiling import trace
+
+
+def build_model(cfg: TrainConfig):
+    """Construct the mode's flax module and its per-image train FLOPs."""
+    m = cfg.model
+    mode = cfg.run.mode
+    if mode == "pretrain":
+        enc = preset(m.preset, labels=None, **{"mask_ratio": 0.75, **m.overrides})
+        dec = DecoderConfig(
+            layers=m.dec_layers, dim=m.dec_dim, heads=m.dec_heads, dtype=m.dec_dtype
+        )
+        model = MAEPretrainModel(enc, dec, norm_pix_loss=m.norm_pix_loss)
+        flops = pretrain_flops_per_image(enc, dec)
+        return model, enc, flops
+    linear = mode == "linear"
+    enc = preset(
+        m.preset,
+        **{
+            "mask_ratio": None,
+            "linear_probing": linear,
+            "batch_norm": linear,
+            **m.overrides,
+        },
+    )
+    model = ClassificationModel(
+        enc,
+        mixup_alpha=m.mixup,
+        cutmix_alpha=m.cutmix,
+        label_smoothing=m.label_smoothing,
+        criterion=m.criterion,
+    )
+    return model, enc, classify_flops_per_image(enc)
+
+
+def _example_batch(cfg: TrainConfig, per_process: int) -> dict:
+    shape = (per_process, cfg.data.image_size, cfg.data.image_size, 3)
+    batch = {"images": np.zeros(shape, np.uint8)}
+    if cfg.run.mode != "pretrain":
+        batch["labels"] = np.zeros((per_process,), np.int32)
+    return split_for_accum(batch, cfg.run.grad_accum)
+
+
+def _strip_for_model(cfg: TrainConfig, batch: dict) -> dict:
+    if cfg.run.mode == "pretrain":
+        return {"images": batch["images"]}
+    return {k: batch[k] for k in ("images", "labels") if k in batch}
+
+
+def make_train_iterator(cfg: TrainConfig, mesh, per_process: int):
+    if cfg.run.synthetic_data:
+        it = synthetic_batches(
+            per_process,
+            cfg.data.image_size,
+            labels=1000 if cfg.run.mode != "pretrain" else None,
+            grad_accum=cfg.run.grad_accum,
+            seed=cfg.run.seed,
+        )
+        source = None
+    else:
+        source = TrainLoader(
+            cfg.data,
+            per_process,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+        it = (split_for_accum(b, cfg.run.grad_accum) for b in source)
+    it = ({k: v for k, v in b.items() if k != "valid"} for b in it)
+    it = (_strip_for_model(cfg, b) for b in it)
+    sharding = batch_sharding(mesh, accum=cfg.run.grad_accum > 1)
+    return prefetch_to_device(it, sharding), source
+
+
+def make_valid_iterator(cfg: TrainConfig, mesh, per_process: int):
+    sharding = batch_sharding(mesh, accum=False)
+    if cfg.run.synthetic_data:
+        def gen():
+            it = synthetic_batches(
+                per_process,
+                cfg.data.image_size,
+                labels=1000 if cfg.run.mode != "pretrain" else None,
+                seed=cfg.run.seed + 1,
+            )
+            for _, batch in zip(range(4), it):
+                batch["valid"] = np.ones((per_process,), bool)
+                yield batch
+
+        return lambda: prefetch_to_device(gen(), sharding)
+    if not cfg.data.valid_shards:
+        return None
+    return lambda: prefetch_to_device(
+        valid_loader(
+            cfg.data,
+            per_process,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        ),
+        sharding,
+    )
+
+
+def evaluate(eval_step, state, batches, pad_batch: dict | None = None) -> dict[str, float]:
+    """Weighted-exact eval aggregation (sums / num_samples — fixes the
+    reference's pretrain val-loss normalization, SURVEY defect #2).
+
+    Multi-host: the jitted eval step contains collectives, so every process
+    must issue the SAME number of calls even when shard striping gives them
+    different batch counts. Processes that run out of data keep feeding
+    ``pad_batch`` (all rows ``valid=False``) until every process is done —
+    agreement reached with a tiny host-level all-gather per round.
+    """
+    multi = jax.process_count() > 1
+    if multi:
+        from jax.experimental import multihost_utils
+
+    totals: dict[str, float] = {}
+    it = iter(batches)
+    i = 0
+    while True:
+        batch = next(it, None)
+        if multi:
+            anyone_has_data = bool(
+                multihost_utils.process_allgather(
+                    np.asarray(batch is not None)
+                ).any()
+            )
+            if not anyone_has_data:
+                break
+            if batch is None:
+                if pad_batch is None:
+                    raise ValueError(
+                        "multi-host eval needs pad_batch for exhausted processes"
+                    )
+                batch = pad_batch
+        elif batch is None:
+            break
+        sums = jax.device_get(eval_step(state, batch, i))
+        i += 1
+        for k, v in sums.items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+    n = max(totals.pop("num_samples", 0.0), 1.0)
+    return {f"val/{k}": v / n for k, v in totals.items()}
+
+
+def train(cfg: TrainConfig) -> dict:
+    """Run the configured job; returns the final summary metrics."""
+    run = cfg.run
+    process_count = jax.process_count()
+    if run.train_batch_size % (process_count * run.grad_accum):
+        raise ValueError(
+            f"process_count * grad_accum ({process_count} * {run.grad_accum}) "
+            f"must divide the global batch size ({run.train_batch_size})"
+        )
+    per_process = run.train_batch_size // process_count
+    per_process_valid = max(1, run.valid_batch_size // process_count)
+
+    mesh = create_mesh(cfg.mesh)
+    model, enc_cfg, flops_per_image = build_model(cfg)
+    tx = make_optimizer(
+        cfg.optim, run.train_batch_size, num_layers=enc_cfg.layers
+    )
+
+    example = _example_batch(cfg, per_process)
+    state, state_sharding = create_sharded_state(
+        model,
+        tx,
+        example,
+        mesh,
+        mode="pretrain" if run.mode == "pretrain" else "classify",
+        init_seed=run.init_seed,
+        rng_seed=run.seed,
+    )
+
+    if run.pretrained_ckpt:
+        state = state.replace(
+            params=load_pretrained_params(run.pretrained_ckpt, state.params)
+        )
+
+    ckpt = Checkpointer(cfg.checkpoint_config())
+    start_step = 0
+    if run.resume and ckpt.latest_step() is not None:
+        state, extra = ckpt.restore(state, sharding=state_sharding)
+        start_step = int(state.step)
+        print(f"[train] resumed from step {start_step}")
+
+    mode_key = "pretrain" if run.mode == "pretrain" else "classify"
+    train_step = make_train_step(
+        mesh, state_sharding, mode=mode_key, grad_accum=run.grad_accum
+    )
+    eval_step = make_eval_step(mesh, state_sharding, mode=mode_key)
+
+    is_main = jax.process_index() == 0
+    logger = MetricLogger(
+        Path(run.output_dir) / run.name,
+        name=run.name,
+        config=config_to_dict(cfg),
+        enabled=is_main,
+        use_wandb=run.use_wandb,
+    )
+    valid_factory = make_valid_iterator(cfg, mesh, per_process_valid)
+    # all-padding eval batch, pre-sharded by EVERY process at setup so
+    # exhausted hosts can keep stepping the collective eval program
+    pad_batch = None
+    if valid_factory is not None and process_count > 1:
+        size = cfg.data.image_size
+        host_pad = {
+            "images": np.zeros((per_process_valid, size, size, 3), np.uint8),
+            "labels": np.full((per_process_valid,), -1, np.int32),
+            "valid": np.zeros((per_process_valid,), bool),
+        }
+        pad_batch = next(
+            prefetch_to_device(iter([host_pad]), batch_sharding(mesh, accum=False))
+        )
+
+    if run.sanity_eval and valid_factory is not None:
+        print(
+            "[train] sanity eval:",
+            evaluate(eval_step, state, valid_factory(), pad_batch),
+        )
+
+    train_iter, source = make_train_iterator(cfg, mesh, per_process)
+    meter = AverageMeter()
+    timer = StepTimer(warmup_steps=min(2, max(1, run.training_steps - 1)))
+    n_chips = len(jax.devices())
+    last_metrics: dict[str, float] = {}
+
+    with trace(run.profile_dir or None):
+        pending: list = []
+        for step in range(start_step + 1, run.training_steps + 1):
+            state, metrics = train_step(state, next(train_iter))
+            pending.append(metrics)  # device arrays; fetched at log time
+            timer.tick()
+
+            if step % run.log_interval == 0 or step == run.training_steps:
+                # sync ONLY at log boundaries — per-step device_get/block
+                # would serialize host dispatch against device compute
+                for m in jax.device_get(pending):
+                    meter.update(m)
+                pending.clear()
+                summary = meter.summary("train/")
+                sps = timer.steps_per_sec
+                if sps:
+                    imgs = sps * run.train_batch_size
+                    rep = mfu_report(flops_per_image, imgs / n_chips)
+                    summary |= {
+                        "perf/images_per_sec": imgs,
+                        "perf/images_per_sec_per_chip": imgs / n_chips,
+                        "perf/mfu": rep.mfu,
+                        "perf/tflops_per_chip": rep.achieved_tflops,
+                    }
+                logger.log(summary, step=step)
+                last_metrics = summary
+
+            if step % run.eval_interval == 0 or step == run.training_steps:
+                if valid_factory is not None:
+                    val = evaluate(eval_step, state, valid_factory(), pad_batch)
+                    logger.log(val, step=step)
+                    last_metrics |= val
+                    ckpt.save(step, state, metrics=val)
+                else:
+                    ckpt.save(step, state)
+
+    ckpt.wait()
+    ckpt.close()
+    logger.close()
+    if source is not None:
+        source.close()
+    return last_metrics
+
+
+def main(argv: list[str] | None = None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", type=str, default=None, help="YAML recipe path")
+    parser.add_argument(
+        "--set",
+        dest="overrides",
+        nargs="*",
+        default=[],
+        help="dotted config overrides: optim.learning_rate=1e-3",
+    )
+    parser.add_argument(
+        "--distributed",
+        action="store_true",
+        help="call jax.distributed.initialize() (multi-host pods)",
+    )
+    args = parser.parse_args(argv)
+    if args.distributed:
+        jax.distributed.initialize()
+    cfg = load_config(args.config, args.overrides)
+    metrics = train(cfg)
+    print("[train] done:", metrics)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,37 @@
+from jumbo_mae_tpu_tpu.data.loader import (
+    DataConfig,
+    TrainLoader,
+    batch_train_samples,
+    batch_valid_samples,
+    prefetch_to_device,
+    split_for_accum,
+    train_sample_stream,
+    valid_loader,
+    valid_sample_stream,
+)
+from jumbo_mae_tpu_tpu.data.shards import expand_shards, shuffle_shards, split_shards
 from jumbo_mae_tpu_tpu.data.synthetic import synthetic_batches
+from jumbo_mae_tpu_tpu.data.tario import (
+    iter_shards_samples,
+    iter_tar_samples,
+    write_tar_samples,
+)
 
-__all__ = ["synthetic_batches"]
+__all__ = [
+    "DataConfig",
+    "TrainLoader",
+    "batch_train_samples",
+    "batch_valid_samples",
+    "expand_shards",
+    "iter_shards_samples",
+    "iter_tar_samples",
+    "prefetch_to_device",
+    "shuffle_shards",
+    "split_for_accum",
+    "split_shards",
+    "synthetic_batches",
+    "train_sample_stream",
+    "valid_loader",
+    "valid_sample_stream",
+    "write_tar_samples",
+]

@@ -1,0 +1,65 @@
+"""Data-worker subprocess entry point.
+
+Workers are FRESH interpreters launched as ``python -m
+jumbo_mae_tpu_tpu.data._worker`` — not ``multiprocessing`` children. That
+sidesteps both classic loader failure modes at once: ``spawn`` re-imports the
+user's ``__main__`` (breaks plain scripts and stdin sessions), and ``fork``
+duplicates a parent that already holds multithreaded XLA/TPU runtime state
+(deadlock risk the JAX runtime explicitly warns about). A fresh interpreter
+imports only this module and never initializes an accelerator backend
+(``JAX_PLATFORMS=cpu`` is exported by the parent as a belt-and-braces guard;
+nothing here imports jax at all).
+
+Protocol: the worker reads a JSON config blob from argv, then streams batches
+to stdout as length-prefixed pickle frames:
+
+    [8-byte big-endian length][pickle({"images": ..., "labels": ...})] ...
+
+Backpressure is the pipe buffer: the parent reads frames into a bounded
+queue; when it stops reading, the worker blocks on write. Worker death is an
+EOF on the pipe — the parent detects it per worker instead of hanging.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import struct
+import sys
+
+
+def _run(cfg_json: str) -> None:
+    from jumbo_mae_tpu_tpu.data.loader import (
+        DataConfig,
+        batch_train_samples,
+        train_sample_stream,
+    )
+
+    spec = json.loads(cfg_json)
+    cfg = DataConfig(**spec["data"])
+    stream = train_sample_stream(
+        cfg,
+        process_index=spec["process_index"],
+        process_count=spec["process_count"],
+        worker_index=spec["worker_index"],
+        worker_count=spec["worker_count"],
+    )
+    out = sys.stdout.buffer
+    for batch in batch_train_samples(stream, spec["batch_size"], cfg.repeats):
+        payload = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
+        out.write(struct.pack(">Q", len(payload)))
+        out.write(payload)
+        out.flush()
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        raise SystemExit("usage: python -m jumbo_mae_tpu_tpu.data._worker <json>")
+    try:
+        _run(sys.argv[1])
+    except (BrokenPipeError, KeyboardInterrupt):
+        pass
+
+
+if __name__ == "__main__":
+    main()

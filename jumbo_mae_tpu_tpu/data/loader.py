@@ -1,0 +1,494 @@
+"""Streaming dataloader: shards → decode → augment → batches → device.
+
+The TPU-native replacement for the reference's webdataset + torch DataLoader
+stack (``/root/reference/src/dataset.py:100-161``). Same external contracts:
+
+- train: infinite stream, deterministic shard order shuffle per epoch,
+  per-process striping, per-worker split, streaming sample shuffle,
+  repeated augmentation with clones de-interleaved across the batch
+  (``collate_and_shuffle``, ``/root/reference/src/dataset.py:85-92``);
+- valid: one sequential pass, final partial batch padded to full size with
+  ``valid=False`` rows and ``label=-1`` (the reference's ``-1``-pad contract,
+  ``/root/reference/src/dataset.py:95-97``), so every process issues the same
+  number of identically-shaped steps;
+- batches are host numpy uint8 NHWC; normalization runs on device.
+
+Differences by design: workers are ``multiprocessing`` processes owned by
+this module (no torch), every worker's stream is reproducible from (seed,
+process_index, worker_index, epoch), and batches land on device through a
+double-buffered ``jax.device_put`` with an explicit ``NamedSharding`` so
+host→device copy overlaps compute (the reference relied on pmap's implicit
+transfer with no overlap).
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+from collections.abc import Iterator
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from jumbo_mae_tpu_tpu.data.decode import decode_image, decode_label, find_image_key
+from jumbo_mae_tpu_tpu.data.randaugment import auto_augment_factory
+from jumbo_mae_tpu_tpu.data.shards import expand_shards, shuffle_shards, split_shards
+from jumbo_mae_tpu_tpu.data.tario import iter_shards_samples
+from jumbo_mae_tpu_tpu.data.transforms import (
+    color_jitter,
+    eval_transform,
+    random_erasing,
+    random_hflip,
+    random_resized_crop,
+    simple_resize_crop,
+)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Pipeline knobs; defaults mirror the reference's argparse defaults
+    (``/root/reference/src/main_finetune.py:97-160``)."""
+
+    train_shards: str | list[str] = ""
+    valid_shards: str | list[str] = ""
+    image_size: int = 224
+    labeled: bool = True
+    crop_mode: str = "rrc"  # rrc | src | none
+    min_scale: float = 0.2
+    hflip: float = 0.5
+    auto_augment: str = "none"
+    color_jitter: float = 0.0
+    random_erasing: float = 0.0
+    repeats: int = 1
+    shuffle_buffer: int = 1000
+    test_crop_ratio: float = 0.875
+    seed: int = 0
+    workers: int = 4
+    prefetch_batches: int = 4
+    # use the native C++ threaded tar reader (native/tario.cc) as the IO
+    # substrate instead of per-worker Python tarfile streams
+    use_native: bool = False
+    native_io_threads: int = 4
+    decode_threads: int = 4
+
+
+class TrainTransform:
+    """Per-sample train augmentation chain (crop → flip → policy → jitter →
+    erasing), reproducing ``create_transforms`` train branch
+    (``/root/reference/src/dataset.py:56-75``)."""
+
+    def __init__(self, cfg: DataConfig):
+        self.cfg = cfg
+        self.policy = auto_augment_factory(cfg.auto_augment)
+
+    def __call__(self, rng: np.random.Generator, img: np.ndarray) -> np.ndarray:
+        cfg = self.cfg
+        if cfg.crop_mode == "rrc":
+            img = random_resized_crop(
+                rng, img, cfg.image_size, scale=(cfg.min_scale, 1.0)
+            )
+        elif cfg.crop_mode == "src":
+            img = simple_resize_crop(rng, img, cfg.image_size)
+        else:
+            from jumbo_mae_tpu_tpu.data.transforms import resize
+
+            img = resize(img, (cfg.image_size, cfg.image_size))
+        img = random_hflip(rng, img, cfg.hflip)
+        if self.policy is not None:
+            img = self.policy(rng, img)
+        if cfg.color_jitter > 0:
+            img = color_jitter(rng, img, cfg.color_jitter)
+        if cfg.random_erasing > 0:
+            img = random_erasing(rng, img, cfg.random_erasing)
+        return np.ascontiguousarray(img)
+
+
+def _shuffle_stream(
+    it: Iterator, buffer_size: int, rng: np.random.Generator
+) -> Iterator:
+    """Streaming buffer shuffle (webdataset ``detshuffle`` equivalent)."""
+    if buffer_size <= 1:
+        yield from it
+        return
+    buf: list = []
+    for x in it:
+        if len(buf) < buffer_size:
+            buf.append(x)
+            continue
+        i = int(rng.integers(len(buf)))
+        buf[i], x = x, buf[i]
+        yield x
+    rng.shuffle(buf)  # type: ignore[arg-type]
+    yield from buf
+
+
+def train_sample_stream(
+    cfg: DataConfig,
+    *,
+    process_index: int = 0,
+    process_count: int = 1,
+    worker_index: int = 0,
+    worker_count: int = 1,
+    start_epoch: int = 0,
+) -> Iterator[tuple[np.ndarray, int]]:
+    """Infinite (image, label) stream for one (process, worker) pair."""
+    shards = expand_shards(cfg.train_shards)
+    transform = TrainTransform(cfg)
+    epoch = start_epoch
+    while True:
+        rng = np.random.default_rng(
+            (cfg.seed, 1, process_index, worker_index, epoch)
+        )
+        epoch_shards = split_shards(
+            shuffle_shards(shards, seed=cfg.seed, epoch=epoch),
+            process_index=process_index,
+            process_count=process_count,
+            worker_index=worker_index,
+            worker_count=worker_count,
+        )
+
+        def decoded():
+            for sample in iter_shards_samples(epoch_shards):
+                img_key = find_image_key(sample)
+                if img_key is None:
+                    continue
+                img = decode_image(sample[img_key])  # type: ignore[arg-type]
+                if img is None:
+                    continue
+                label = decode_label(sample["cls"]) if "cls" in sample else -1
+                yield img, label
+
+        for img, label in _shuffle_stream(decoded(), cfg.shuffle_buffer, rng):
+            for _ in range(cfg.repeats):
+                yield transform(rng, img), label
+        epoch += 1
+
+
+def valid_sample_stream(
+    cfg: DataConfig, *, process_index: int = 0, process_count: int = 1
+) -> Iterator[tuple[np.ndarray, int]]:
+    """One sequential eval pass over this process's stripe of the valid set."""
+    shards = split_shards(
+        expand_shards(cfg.valid_shards),
+        process_index=process_index,
+        process_count=process_count,
+    )
+    for sample in iter_shards_samples(shards):
+        img_key = find_image_key(sample)
+        if img_key is None:
+            continue
+        img = decode_image(sample[img_key])  # type: ignore[arg-type]
+        if img is None:
+            continue
+        label = decode_label(sample["cls"]) if "cls" in sample else -1
+        yield eval_transform(img, cfg.image_size, crop_ratio=cfg.test_crop_ratio), label
+
+
+def native_train_stream(
+    cfg: DataConfig,
+    *,
+    process_index: int = 0,
+    process_count: int = 1,
+    start_epoch: int = 0,
+) -> Iterator[tuple[np.ndarray, int]]:
+    """Native-IO train stream: C++ reader threads feed raw image bytes, a
+    thread pool does decode+augment (cv2/PIL release the GIL, so this scales
+    within one process where the pure-Python path needs worker processes).
+
+    One epoch of the process's shard stripe per native reader; shard order is
+    reshuffled per epoch like :func:`train_sample_stream`.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from jumbo_mae_tpu_tpu.data.native import NativeShardReader
+
+    shards = expand_shards(cfg.train_shards)
+    transform = TrainTransform(cfg)
+    epoch = start_epoch
+    with ThreadPoolExecutor(max_workers=max(1, cfg.decode_threads)) as pool:
+        while True:
+            rng = np.random.default_rng((cfg.seed, 2, process_index, epoch))
+            epoch_shards = split_shards(
+                shuffle_shards(shards, seed=cfg.seed, epoch=epoch),
+                process_index=process_index,
+                process_count=process_count,
+            )
+
+            def decode_one(pair):
+                payload, label = pair
+                img = decode_image(payload)
+                return None if img is None else (img, label)
+
+            def decoded(reader):
+                # bounded in-flight futures (NOT pool.map, which eagerly
+                # drains the whole reader and buffers an epoch of JPEGs):
+                # the window is what keeps backpressure on the C++ queue
+                from collections import deque
+
+                window: deque = deque()
+                depth = max(2, cfg.decode_threads * 4)
+                for pair in reader:
+                    window.append(pool.submit(decode_one, pair))
+                    if len(window) >= depth:
+                        r = window.popleft().result()
+                        if r is not None:
+                            yield r
+                while window:
+                    r = window.popleft().result()
+                    if r is not None:
+                        yield r
+
+            with NativeShardReader(
+                epoch_shards, threads=cfg.native_io_threads, loop=False
+            ) as reader:
+                for img, label in _shuffle_stream(
+                    decoded(reader), cfg.shuffle_buffer, rng
+                ):
+                    for _ in range(cfg.repeats):
+                        yield transform(rng, img), label
+            epoch += 1
+
+
+def _deinterleave(indices: int, repeats: int) -> np.ndarray:
+    """Batch reorder that spreads repeated-augmentation clones across the
+    batch: position j ← sample j*repeats % n adjusted — equivalent to the
+    reference's ``batch[i::repeats]`` concatenation
+    (``/root/reference/src/dataset.py:91-92``)."""
+    order = np.arange(indices)
+    return np.concatenate([order[i::repeats] for i in range(repeats)])
+
+
+def batch_train_samples(
+    stream: Iterator[tuple[np.ndarray, int]], batch_size: int, repeats: int = 1
+) -> Iterator[dict[str, np.ndarray]]:
+    """Assemble train batches; de-interleave repeat clones."""
+    order = _deinterleave(batch_size, max(1, repeats))
+    while True:
+        pairs = [next(stream) for _ in range(batch_size)]
+        images = np.stack([p[0] for p in pairs])[order]
+        labels = np.asarray([p[1] for p in pairs], np.int32)[order]
+        yield {"images": images, "labels": labels}
+
+
+def batch_valid_samples(
+    stream: Iterator[tuple[np.ndarray, int]],
+    batch_size: int,
+    image_size: int,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Assemble eval batches; pad the final partial batch (valid=False,
+    label=-1) so step shapes stay constant."""
+    images = np.zeros((batch_size, image_size, image_size, 3), np.uint8)
+    labels = np.full((batch_size,), -1, np.int32)
+    valid = np.zeros((batch_size,), bool)
+    n = 0
+    for img, label in stream:
+        images[n], labels[n], valid[n] = img, label, True
+        n += 1
+        if n == batch_size:
+            yield {"images": images.copy(), "labels": labels.copy(), "valid": valid.copy()}
+            images = np.zeros_like(images)
+            labels = np.full_like(labels, -1)
+            valid = np.zeros_like(valid)
+            n = 0
+    if n:
+        yield {"images": images, "labels": labels, "valid": valid}
+
+
+class _Worker:
+    """One data-worker subprocess + its pipe-reader thread and batch queue.
+
+    The worker is a FRESH interpreter (``python -m
+    jumbo_mae_tpu_tpu.data._worker``), not a multiprocessing child — see
+    ``data/_worker.py`` for why (spawn re-imports the user's __main__; fork
+    duplicates a live multithreaded XLA runtime). The reader thread turns the
+    stdout frame stream into a bounded queue; EOF marks the worker dead so
+    the consumer can skip it instead of hanging.
+    """
+
+    def __init__(self, spec: dict, queue_size: int):
+        import json
+        import os
+        import subprocess
+        import sys
+        import threading
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"  # belt and braces; workers never use jax
+        repo_root = str(Path(__file__).resolve().parent.parent.parent)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "jumbo_mae_tpu_tpu.data._worker", json.dumps(spec)],
+            stdout=subprocess.PIPE,
+            env=env,
+        )
+        self.queue: queue_mod.Queue = queue_mod.Queue(maxsize=queue_size)
+        self.dead = False
+        self._thread = threading.Thread(target=self._read_loop, daemon=True)
+        self._thread.start()
+
+    def _read_loop(self):
+        import pickle
+        import struct
+
+        stream = self.proc.stdout
+        try:
+            while True:
+                header = stream.read(8)
+                if len(header) < 8:
+                    break
+                (length,) = struct.unpack(">Q", header)
+                payload = stream.read(length)
+                if len(payload) < length:
+                    break
+                self.queue.put(pickle.loads(payload))
+        except (OSError, ValueError):  # pragma: no cover - pipe torn down
+            pass
+        finally:
+            self.dead = True
+
+    def stop(self):
+        self.dead = True
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001  # pragma: no cover
+                self.proc.kill()
+        if self.proc.stdout is not None:
+            self.proc.stdout.close()
+
+
+class TrainLoader:
+    """Infinite train-batch iterator backed by worker subprocesses.
+
+    Each worker owns a disjoint shard stripe and yields WHOLE per-process
+    batches (the torch IterableDataset-per-worker batching the reference
+    inherited); the parent round-robins worker queues, skipping dead workers
+    and raising only when none are left. ``workers=0`` runs inline — the
+    mode tests and CPU smoke configs use.
+    """
+
+    def __init__(
+        self,
+        cfg: DataConfig,
+        batch_size: int,
+        *,
+        process_index: int = 0,
+        process_count: int = 1,
+    ):
+        if batch_size % max(1, cfg.repeats):
+            raise ValueError(
+                f"repeats ({cfg.repeats}) must divide the per-process batch "
+                f"size ({batch_size})"
+            )
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self._workers: list[_Worker] = []
+        if cfg.use_native:
+            stream = native_train_stream(
+                cfg, process_index=process_index, process_count=process_count
+            )
+            self._inline = batch_train_samples(stream, batch_size, cfg.repeats)
+            return
+        if cfg.workers <= 0:
+            stream = train_sample_stream(
+                cfg, process_index=process_index, process_count=process_count
+            )
+            self._inline = batch_train_samples(stream, batch_size, cfg.repeats)
+            return
+        self._inline = None
+        from dataclasses import asdict
+
+        per_worker_q = max(1, cfg.prefetch_batches // cfg.workers)
+        for w in range(cfg.workers):
+            spec = {
+                "data": asdict(cfg),
+                "batch_size": batch_size,
+                "process_index": process_index,
+                "process_count": process_count,
+                "worker_index": w,
+                "worker_count": cfg.workers,
+            }
+            self._workers.append(_Worker(spec, per_worker_q))
+        self._next_worker = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict[str, np.ndarray]:
+        if self._inline is not None:
+            return next(self._inline)
+        attempts_left = 120  # x 5s = 10 min of silence before giving up
+        while True:
+            live = [w for w in self._workers if not (w.dead and w.queue.empty())]
+            if not live:
+                raise RuntimeError("all data workers died")
+            w = live[self._next_worker % len(live)]
+            self._next_worker += 1
+            try:
+                return w.queue.get(timeout=5)
+            except queue_mod.Empty:
+                attempts_left -= 1
+                if attempts_left <= 0:
+                    raise RuntimeError(
+                        "data workers alive but produced nothing for 10 minutes"
+                    ) from None
+
+    def close(self):
+        for w in self._workers:
+            w.stop()
+        self._workers.clear()
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def valid_loader(
+    cfg: DataConfig,
+    batch_size: int,
+    *,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Fresh sequential eval iterator (construct per evaluation)."""
+    stream = valid_sample_stream(
+        cfg, process_index=process_index, process_count=process_count
+    )
+    return batch_valid_samples(stream, batch_size, cfg.image_size)
+
+
+def split_for_accum(batch: dict, grad_accum: int) -> dict:
+    """Reshape (B, ...) leaves to (accum, B/accum, ...) for the scan-based
+    accumulation step."""
+    if grad_accum <= 1:
+        return batch
+    return {
+        k: v.reshape(grad_accum, v.shape[0] // grad_accum, *v.shape[1:])
+        for k, v in batch.items()
+    }
+
+
+def prefetch_to_device(it: Iterator[dict], sharding, buffer_size: int = 2) -> Iterator[dict]:
+    """Double-buffered host→device transfer: keep ``buffer_size`` batches in
+    flight as sharded device arrays so the copy overlaps the previous step's
+    compute. With a multi-process mesh, per-host batches are the local stripe
+    of the global batch (``jax.make_array_from_process_local_data``)."""
+    import jax
+
+    def put(batch):
+        try:
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+            )
+        except ValueError:
+            return jax.device_put(batch, sharding)
+
+    pending: list = []
+    for batch in it:
+        pending.append(put(batch))
+        if len(pending) > buffer_size:
+            yield pending.pop(0)
+    yield from pending

@@ -1,0 +1,147 @@
+"""ctypes binding for the native tar reader (``native/tario.cc``).
+
+Builds ``libtario.so`` on first use with g++ (cached beside the source);
+everything degrades gracefully — ``available()`` is False when no toolchain
+exists and callers fall back to the pure-Python ``tario`` path.
+
+Usage:
+    with NativeShardReader(urls, threads=8) as r:
+        for image_bytes, label in r: ...
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_SO_PATH = _NATIVE_DIR / "libtario.so"
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> bool:
+    src = _NATIVE_DIR / "tario.cc"
+    if not src.exists():
+        return False
+    if _SO_PATH.exists() and _SO_PATH.stat().st_mtime >= src.stat().st_mtime:
+        return True
+    try:
+        subprocess.run(
+            [
+                "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                "-o", str(_SO_PATH), str(src), "-lpthread",
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not _build():
+            return None
+        lib = ctypes.CDLL(str(_SO_PATH))
+        lib.tario_open.restype = ctypes.c_void_p
+        lib.tario_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.tario_next.restype = ctypes.c_int
+        lib.tario_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.tario_free.argtypes = [ctypes.c_void_p]
+        lib.tario_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeShardReader:
+    """Iterate (image_bytes, label) pairs produced by native reader threads.
+
+    ``loop=True`` re-reads the shard list forever (training);
+    ``loop=False`` is one pass (eval). Not async-safe across iterators —
+    one consumer per reader.
+    """
+
+    def __init__(
+        self,
+        urls: list[str],
+        *,
+        threads: int = 4,
+        queue_capacity: int = 256,
+        loop: bool = False,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native tario library unavailable (no g++?)")
+        if not urls:
+            raise ValueError("no shard urls")
+        self._lib = lib
+        blob = b"".join(u.encode() + b"\0" for u in urls) + b"\0"
+        self._handle = lib.tario_open(
+            blob, int(threads), int(queue_capacity), int(loop)
+        )
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> tuple[bytes, int]:
+        if self._closed:
+            raise StopIteration
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        length = ctypes.c_int64()
+        label = ctypes.c_int64()
+        token = ctypes.c_void_p()
+        ok = self._lib.tario_next(
+            self._handle,
+            ctypes.byref(data),
+            ctypes.byref(length),
+            ctypes.byref(label),
+            ctypes.byref(token),
+        )
+        if not ok:
+            self.close()
+            raise StopIteration
+        try:
+            payload = ctypes.string_at(data, length.value)
+        finally:
+            self._lib.tario_free(token)
+        return payload, int(label.value)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._lib.tario_close(self._handle)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,159 @@
+"""Streaming tar reading: URL → byte stream → grouped samples.
+
+Replaces the reference's ``wds.gopen`` + ``tarfile_to_samples`` pair
+(``/root/reference/src/dataset.py:113-119``, ``/root/reference/src/utils.py:55-63``
+for the write side). A sample is all consecutive tar members sharing a
+basename stem: ``n01440764_10026.jpg`` + ``n01440764_10026.cls`` →
+``{"__key__": "n01440764_10026", "jpg": b..., "cls": b...}``.
+
+Supported URL schemes (both read and write):
+
+- plain local paths / ``file://``;
+- ``pipe:CMD`` — run CMD in a shell, read its stdout (write: its stdin); this
+  is the escape hatch that makes every remote store work (``pipe:gsutil cat
+  gs://...``), exactly the contract webdataset exposed;
+- ``gs://`` — sugar for the gsutil pipe;
+- ``http(s)://`` — urllib streaming read.
+
+Corrupt tar members or truncated archives are skipped with a warning, the
+reference's ``ignore_and_continue`` policy.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import subprocess
+import tarfile
+from collections.abc import Iterator
+from contextlib import contextmanager
+from urllib.parse import urlparse
+
+logger = logging.getLogger(__name__)
+
+Sample = dict[str, bytes | str]
+
+
+@contextmanager
+def open_url(url: str, mode: str = "rb"):
+    """Open a shard URL as a (possibly piped) binary stream."""
+    if mode not in ("rb", "wb"):
+        raise ValueError(f"mode must be rb/wb, got {mode!r}")
+    write = mode == "wb"
+    if url.startswith("pipe:"):
+        cmd = url[len("pipe:") :]
+        proc = subprocess.Popen(
+            cmd,
+            shell=True,
+            stdin=subprocess.PIPE if write else None,
+            stdout=None if write else subprocess.PIPE,
+        )
+        stream = proc.stdin if write else proc.stdout
+        try:
+            yield stream
+        finally:
+            stream.close()
+            ret = proc.wait()
+            if ret != 0:
+                raise RuntimeError(f"pipe command failed ({ret}): {cmd}")
+        return
+    if url.startswith("gs://"):
+        q = shell_quote(url)
+        pipe = f"pipe:gsutil cp - {q}" if write else f"pipe:gsutil cat {q}"
+        with open_url(pipe, mode) as s:
+            yield s
+        return
+    if url.startswith(("http://", "https://")):
+        if write:
+            raise ValueError("cannot write to http(s) URLs")
+        import urllib.request
+
+        with urllib.request.urlopen(url) as s:
+            yield s
+        return
+    path = urlparse(url).path if url.startswith("file://") else url
+    with open(path, mode) as s:
+        yield s
+
+
+def shell_quote(s: str) -> str:
+    import shlex
+
+    return shlex.quote(s)
+
+
+def iter_tar(stream) -> Iterator[tuple[str, bytes]]:
+    """Yield (member_name, payload) from a non-seekable tar stream."""
+    try:
+        with tarfile.open(fileobj=stream, mode="r|*") as tar:
+            for member in tar:
+                if not member.isreg():
+                    continue
+                f = tar.extractfile(member)
+                if f is None:
+                    continue
+                try:
+                    yield member.name, f.read()
+                except tarfile.TarError as e:  # corrupt member: skip
+                    logger.warning("skipping corrupt member %s: %s", member.name, e)
+    except tarfile.TarError as e:  # truncated archive: stop this shard
+        logger.warning("truncated/corrupt tar stream: %s", e)
+
+
+def _split_member(name: str) -> tuple[str, str]:
+    """``dir/key.ext`` → (``dir/key``, ``ext``); extension is everything after
+    the FIRST dot of the basename (webdataset convention, so ``x.seg.png``
+    keys on ``seg.png``)."""
+    slash = name.rfind("/")
+    dot = name.find(".", slash + 1)
+    if dot < 0:
+        return name, ""
+    return name[:dot], name[dot + 1 :].lower()
+
+
+def group_samples(members: Iterator[tuple[str, bytes]]) -> Iterator[Sample]:
+    """Group consecutive members with a shared stem into sample dicts."""
+    current: Sample = {}
+    key: str | None = None
+    for name, payload in members:
+        stem, ext = _split_member(name)
+        if stem != key:
+            if current:
+                yield current
+            current, key = {"__key__": stem}, stem
+        current[ext] = payload
+    if current:
+        yield current
+
+
+def iter_tar_samples(url: str) -> Iterator[Sample]:
+    """Stream one shard URL as grouped samples; never raises on bad data."""
+    try:
+        with open_url(url) as stream:
+            yield from group_samples(iter_tar(stream))
+    except (OSError, RuntimeError) as e:
+        logger.warning("skipping unreadable shard %s: %s", url, e)
+
+
+def iter_shards_samples(urls: list[str]) -> Iterator[Sample]:
+    """Stream several shards back to back, tagging each sample with its
+    ``__url__`` (useful for resume diagnostics)."""
+    for url in urls:
+        for sample in iter_tar_samples(url):
+            sample["__url__"] = url
+            yield sample
+
+
+def write_tar_samples(url: str, samples: list[Sample]) -> None:
+    """Write samples to a tar shard (test fixtures; dataset prep tooling)."""
+    with open_url(url, "wb") as stream:
+        with tarfile.open(fileobj=stream, mode="w|") as tar:
+            for sample in samples:
+                key = str(sample["__key__"])
+                for ext, payload in sample.items():
+                    if ext.startswith("__"):
+                        continue
+                    assert isinstance(payload, bytes), (key, ext)
+                    info = tarfile.TarInfo(f"{key}.{ext}")
+                    info.size = len(payload)
+                    tar.addfile(info, io.BytesIO(payload))

@@ -1,0 +1,6 @@
+from jumbo_mae_tpu_tpu.interop.torch_convert import (
+    flax_to_torch_state,
+    torch_to_flax_params,
+)
+
+__all__ = ["flax_to_torch_state", "torch_to_flax_params"]

@@ -1,0 +1,226 @@
+"""Bidirectional flax ↔ PyTorch/timm-style checkpoint conversion — for the
+ACTUAL jumbo layout.
+
+The reference shipped converters targeting its upstream's plain-ViT tree
+(``/root/reference/scripts/convert_flax_to_pytorch.py:25-91``,
+``convert_pytorch_to_flax.py:24-101``); they silently ignored every
+jumbo-specific parameter (3 CLS tokens, shared jumbo MLP, ``norm3``/``ls3``
+per block) — SURVEY defect #4. These converters handle the full jumbo
+encoder:
+
+torch-side naming (timm ViT grammar, extended):
+
+- ``cls_tokens``                 (1, K, D)        — K=3 CLS tokens
+- ``patch_embed.proj.{weight,bias}``; ``pos_embed`` (1, N, D) patch-only grid
+- ``blocks.{i}.norm{1,2,3}.*``, ``blocks.{i}.attn.qkv.{weight,bias}`` (fused),
+  ``blocks.{i}.attn.proj.*``, ``blocks.{i}.mlp.fc{1,2}.*``,
+  ``blocks.{i}.ls{1,2,3}.gamma`` (LayerScale)
+- ``jumbo_mlp.fc{1,2}.*``        — stored ONCE (shared across blocks)
+- ``norm.*``, ``head.{weight,bias}``, ``head_bn.{weight,bias,running_mean,running_var}``
+
+Round-trip is exact (pure transpose/reshape/concat algebra, no recompute).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["flax_to_torch_state", "torch_to_flax_params"]
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def _find_encoder(params: dict) -> dict:
+    for key in ("model", "encoder"):
+        if key in params:
+            return params[key]
+    if "cls_tokens" in params:
+        return params
+    raise KeyError(
+        "no encoder subtree found (expected 'model', 'encoder', or a bare "
+        f"encoder tree); top-level keys: {sorted(params)}"
+    )
+
+
+def _fuse_qkv(attn: dict) -> tuple[np.ndarray, np.ndarray]:
+    """flax q/k/v DenseGeneral kernels (D, H, hd) → torch fused qkv
+    (3D, D) weight + (3D,) bias, rows ordered [q; k; v]."""
+    ws, bs = [], []
+    for name in ("q", "k", "v"):
+        k = _np(attn[name]["kernel"])
+        d = k.shape[0]
+        ws.append(k.reshape(d, -1).T)  # (D_out, D_in)
+        bs.append(_np(attn[name]["bias"]).reshape(-1))
+    return np.concatenate(ws, axis=0), np.concatenate(bs, axis=0)
+
+
+def _unfuse_qkv(weight: np.ndarray, bias: np.ndarray, heads: int) -> dict:
+    d = weight.shape[1]
+    head_dim = d // heads
+    out = {}
+    for i, name in enumerate(("q", "k", "v")):
+        w = weight[i * d : (i + 1) * d]  # (D, D)
+        b = bias[i * d : (i + 1) * d]
+        out[name] = {
+            "kernel": w.T.reshape(d, heads, head_dim),
+            "bias": b.reshape(heads, head_dim),
+        }
+    return out
+
+
+def _linear_to_torch(mod: dict) -> dict[str, np.ndarray]:
+    return {"weight": _np(mod["kernel"]).T, "bias": _np(mod["bias"])}
+
+
+def _linear_from_torch(weight: np.ndarray, bias: np.ndarray) -> dict:
+    return {"kernel": _np(weight).T, "bias": _np(bias)}
+
+
+def _norm_to_torch(mod: dict) -> dict[str, np.ndarray]:
+    return {"weight": _np(mod["scale"]), "bias": _np(mod["bias"])}
+
+
+def flax_to_torch_state(params: dict, batch_stats: dict | None = None) -> dict:
+    """Convert a jumbo encoder param tree (a ``ClassificationModel``/
+    ``MAEPretrainModel`` tree or a bare ``JumboViT`` tree) to a torch-style
+    flat state dict of numpy arrays (wrap in ``torch.from_numpy`` to save)."""
+    enc = _find_encoder(params)
+    out: dict[str, np.ndarray] = {}
+
+    out["cls_tokens"] = _np(enc["cls_tokens"])
+    embed = enc["embed"]
+    # flax conv kernel (p, p, 3, D) → torch (D, 3, p, p)
+    out["patch_embed.proj.weight"] = _np(embed["proj"]["kernel"]).transpose(3, 2, 0, 1)
+    out["patch_embed.proj.bias"] = _np(embed["proj"]["bias"])
+    if "pos_embed" in embed:
+        grid = _np(embed["pos_embed"])  # (gh, gw, D)
+        out["pos_embed"] = grid.reshape(1, -1, grid.shape[-1])
+
+    blocks = sorted(
+        (k for k in enc if k.startswith("block_")), key=lambda k: int(k.split("_")[1])
+    )
+    for i, bk in enumerate(blocks):
+        blk = enc[bk]
+        p = f"blocks.{i}."
+        w, b = _fuse_qkv(blk["attn"])
+        out[p + "attn.qkv.weight"], out[p + "attn.qkv.bias"] = w, b
+        proj_k = _np(blk["attn"]["out"]["kernel"])  # (H, hd, D)
+        d = proj_k.shape[-1]
+        out[p + "attn.proj.weight"] = proj_k.reshape(-1, d).T
+        out[p + "attn.proj.bias"] = _np(blk["attn"]["out"]["bias"])
+        for ln in ("ln1", "ln2", "ln3"):
+            if ln in blk:
+                tn = _norm_to_torch(blk[ln])
+                out[p + f"norm{ln[-1]}.weight"] = tn["weight"]
+                out[p + f"norm{ln[-1]}.bias"] = tn["bias"]
+        for ls in ("ls1", "ls2", "ls3"):
+            if ls in blk:
+                out[p + f"{ls}.gamma"] = _np(blk[ls])
+        for fc in ("fc1", "fc2"):
+            lt = _linear_to_torch(blk["mlp"][fc])
+            out[p + f"mlp.{fc}.weight"] = lt["weight"]
+            out[p + f"mlp.{fc}.bias"] = lt["bias"]
+
+    for fc in ("fc1", "fc2"):
+        lt = _linear_to_torch(enc["jumbo_mlp"][fc])
+        out[f"jumbo_mlp.{fc}.weight"] = lt["weight"]
+        out[f"jumbo_mlp.{fc}.bias"] = lt["bias"]
+
+    tn = _norm_to_torch(enc["ln"])
+    out["norm.weight"], out["norm.bias"] = tn["weight"], tn["bias"]
+
+    if "head" in enc:
+        head = enc["head"]
+        if "fc" in head:
+            lt = _linear_to_torch(head["fc"])
+            out["head.weight"], out["head.bias"] = lt["weight"], lt["bias"]
+        if "bn" in head:
+            out["head_bn.weight"] = _np(head["bn"]["scale"])
+            out["head_bn.bias"] = _np(head["bn"]["bias"])
+    if batch_stats is not None:
+        bn_stats = _find_encoder(batch_stats).get("head", {}).get("bn", {})
+        if bn_stats:
+            out["head_bn.running_mean"] = _np(bn_stats["mean"])
+            out["head_bn.running_var"] = _np(bn_stats["var"])
+    return out
+
+
+def torch_to_flax_params(state: dict, *, heads: int) -> dict:
+    """Inverse of :func:`flax_to_torch_state`: torch-style flat dict → bare
+    jumbo encoder tree (nest under ``model``/``encoder`` for warm starts via
+    ``load_pretrained_params``). ``heads`` is needed to re-split the fused
+    qkv. BatchNorm running stats, if present, come back under the key
+    ``__batch_stats__``."""
+    state = {k: _np(v) for k, v in state.items()}
+    enc: dict = {}
+
+    enc["cls_tokens"] = state["cls_tokens"]
+    embed: dict = {
+        "proj": {
+            "kernel": state["patch_embed.proj.weight"].transpose(2, 3, 1, 0),
+            "bias": state["patch_embed.proj.bias"],
+        }
+    }
+    if "pos_embed" in state:
+        pe = state["pos_embed"][0]  # (N, D)
+        side = int(round(np.sqrt(pe.shape[0])))
+        if side * side != pe.shape[0]:
+            raise ValueError(f"non-square pos_embed with {pe.shape[0]} positions")
+        embed["pos_embed"] = pe.reshape(side, side, pe.shape[-1])
+    enc["embed"] = embed
+
+    n_blocks = 1 + max(
+        (int(k.split(".")[1]) for k in state if k.startswith("blocks.")), default=-1
+    )
+    for i in range(n_blocks):
+        p = f"blocks.{i}."
+        blk: dict = {}
+        attn = _unfuse_qkv(state[p + "attn.qkv.weight"], state[p + "attn.qkv.bias"], heads)
+        proj_w = state[p + "attn.proj.weight"]  # (D, D)
+        d = proj_w.shape[0]
+        attn["out"] = {
+            "kernel": proj_w.T.reshape(heads, d // heads, d),
+            "bias": state[p + "attn.proj.bias"],
+        }
+        blk["attn"] = attn
+        for n in ("1", "2", "3"):
+            if p + f"norm{n}.weight" in state:
+                blk[f"ln{n}"] = {
+                    "scale": state[p + f"norm{n}.weight"],
+                    "bias": state[p + f"norm{n}.bias"],
+                }
+            if p + f"ls{n}.gamma" in state:
+                blk[f"ls{n}"] = state[p + f"ls{n}.gamma"]
+        blk["mlp"] = {
+            fc: _linear_from_torch(state[p + f"mlp.{fc}.weight"], state[p + f"mlp.{fc}.bias"])
+            for fc in ("fc1", "fc2")
+        }
+        enc[f"block_{i}"] = blk
+
+    enc["jumbo_mlp"] = {
+        fc: _linear_from_torch(
+            state[f"jumbo_mlp.{fc}.weight"], state[f"jumbo_mlp.{fc}.bias"]
+        )
+        for fc in ("fc1", "fc2")
+    }
+    enc["ln"] = {"scale": state["norm.weight"], "bias": state["norm.bias"]}
+
+    head: dict = {}
+    if "head.weight" in state:
+        head["fc"] = _linear_from_torch(state["head.weight"], state["head.bias"])
+    if "head_bn.weight" in state:
+        head["bn"] = {"scale": state["head_bn.weight"], "bias": state["head_bn.bias"]}
+    if head:
+        enc["head"] = head
+    if "head_bn.running_mean" in state:
+        enc["__batch_stats__"] = {
+            "head": {
+                "bn": {
+                    "mean": state["head_bn.running_mean"],
+                    "var": state["head_bn.running_var"],
+                }
+            }
+        }
+    return enc

@@ -1,4 +1,8 @@
 from jumbo_mae_tpu_tpu.parallel.mesh import MeshConfig, create_mesh
+from jumbo_mae_tpu_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+)
 from jumbo_mae_tpu_tpu.parallel.sharding import (
     batch_sharding,
     infer_state_sharding,
@@ -10,6 +14,8 @@ __all__ = [
     "create_mesh",
     "batch_sharding",
     "infer_state_sharding",
+    "ring_attention",
+    "ring_attention_sharded",
     "shard_param_spec",
 ]
 

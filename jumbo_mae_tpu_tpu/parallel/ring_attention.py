@@ -1,0 +1,97 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference had no long-context story at all (SURVEY §5 — attention
+materializes (B,H,N,N) on one device, ``/root/reference/src/modeling.py:136-137``).
+Here sequences shard over the ``seq`` mesh axis; each device holds a local
+query block and the K/V blocks ROTATE around the ring via ``ppermute`` over
+ICI neighbors, one hop per step, while a running online-softmax (m, l, acc)
+merges each visiting block — exactly one full pass of K/V past every Q shard
+in ``seq_parallel`` hops, with O(S/n) memory per device and compute that
+overlaps the next hop's transfer (the collective-permute is issued before the
+block's einsums, so XLA can run them concurrently).
+
+API:
+- :func:`ring_attention` — per-shard body (call inside ``shard_map``);
+- :func:`ring_attention_sharded` — convenience wrapper that builds the
+  ``shard_map`` over a mesh for globally-(B, S, H, D) inputs sharded on S.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+) -> jax.Array:
+    """Online-softmax attention with K/V ring rotation over ``axis_name``.
+
+    Shapes (per shard): (batch, local_seq, heads, head_dim); queries
+    pre-scaled. Must run inside ``shard_map``/``pmap`` with ``axis_name``
+    bound. Returns the local query block's exact global attention output.
+    """
+    n = jax.lax.psum(1, axis_name)
+    bq, sq, h, d = q.shape
+
+    m0 = jnp.full((bq, h, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, h, sq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, sq, h, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(carry, _):
+        m, l, acc, k_cur, v_cur = carry
+        # issue the rotation FIRST so the transfer overlaps this block's math
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_cur, preferred_element_type=jnp.float32
+        )
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(-1, keepdims=True)
+        acc = acc * alpha.transpose(0, 2, 1, 3) + jnp.einsum(
+            "bhqk,bkhd->bqhd",
+            p.astype(v_cur.dtype),
+            v_cur,
+            preferred_element_type=jnp.float32,
+        )
+        return (m_new, l, acc, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(hop, (m0, l0, acc0, k, v), None, length=n)
+    return (acc / l.transpose(0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+) -> jax.Array:
+    """shard_map wrapper: global (B, S, H, D) inputs with S sharded over
+    ``seq_axis`` (and batch over ``batch_axes``); emits the identically
+    sharded attention output."""
+    spec = P(tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None, seq_axis)
+    fn = shard_map(
+        partial(ring_attention, axis_name=seq_axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,25 @@
+from jumbo_mae_tpu_tpu.utils.logging import MetricLogger, StepTimer
+from jumbo_mae_tpu_tpu.utils.meters import AverageMeter
+from jumbo_mae_tpu_tpu.utils.mfu import (
+    PEAK_TFLOPS,
+    classify_flops_per_image,
+    detect_peak_tflops,
+    encoder_flops_per_image,
+    mfu_report,
+    pretrain_flops_per_image,
+)
+from jumbo_mae_tpu_tpu.utils.profiling import annotate, trace
+
+__all__ = [
+    "AverageMeter",
+    "MetricLogger",
+    "PEAK_TFLOPS",
+    "StepTimer",
+    "annotate",
+    "classify_flops_per_image",
+    "detect_peak_tflops",
+    "encoder_flops_per_image",
+    "mfu_report",
+    "pretrain_flops_per_image",
+    "trace",
+]

@@ -1,0 +1,88 @@
+"""Run logging: wandb when available, JSONL always, process-0 gated.
+
+The reference logged through wandb only, on process 0 only
+(``/root/reference/src/main_pretrain.py:56-57,67-74``); in this environment
+wandb may not exist, so the logger degrades to a local JSONL metrics file
+with the same record shape — nothing in the train loop branches on which
+backend is live.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+
+class MetricLogger:
+    def __init__(
+        self,
+        output_dir: str | Path | None,
+        *,
+        name: str = "run",
+        config: dict | None = None,
+        enabled: bool = True,
+        use_wandb: bool = True,
+    ):
+        self.enabled = enabled
+        self._file = None
+        self._wandb = None
+        if not enabled:
+            return
+        if output_dir is not None:
+            path = Path(output_dir)
+            path.mkdir(parents=True, exist_ok=True)
+            self._file = open(path / f"{name}-metrics.jsonl", "a", buffering=1)
+            if config:
+                (path / f"{name}-config.json").write_text(
+                    json.dumps(config, indent=2, default=str)
+                )
+        if use_wandb:
+            try:  # pragma: no cover - wandb absent in CI
+                import wandb
+
+                self._wandb = wandb.init(name=name, config=config or {})
+            except Exception:  # noqa: BLE001
+                self._wandb = None
+
+    def log(self, metrics: dict, step: int | None = None):
+        if not self.enabled:
+            return
+        record = {"_time": time.time(), **({"step": step} if step is not None else {}), **metrics}
+        if self._file is not None:
+            self._file.write(json.dumps(record, default=float) + "\n")
+        if self._wandb is not None:  # pragma: no cover
+            self._wandb.log(metrics, step=step)
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if self._wandb is not None:  # pragma: no cover
+            self._wandb.finish()
+            self._wandb = None
+
+
+class StepTimer:
+    """Wall-clock step timing with warmup exclusion; feeds MFU reporting."""
+
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self._seen = 0
+        self._t0: float | None = None
+        self._timed = 0
+
+    def tick(self):
+        """Call once per completed (blocked-on) step."""
+        self._seen += 1
+        if self._seen == self.warmup_steps:
+            self._t0 = time.perf_counter()
+            self._timed = 0
+        elif self._seen > self.warmup_steps:
+            self._timed += 1
+
+    @property
+    def steps_per_sec(self) -> float | None:
+        if self._t0 is None or self._timed == 0:
+            return None
+        return self._timed / (time.perf_counter() - self._t0)

@@ -1,0 +1,37 @@
+#!/usr/bin/env python3
+"""Hyperparameter sweep driver — replaces the reference's bash for-loops
+(``/root/reference/config/loop_1.sh``, ``loop_2.sh``: wd × lr grids at
+layer-decay 0.65) with a python grid over config overrides.
+
+Usage: python recipes/sweep_ft.py [--dry-run]
+"""
+
+import argparse
+import itertools
+
+WEIGHT_DECAYS = [0.06, 0.07, 0.08, 0.09]
+LEARNING_RATES = [1e-3, 3e-3]
+LAYER_DECAY = 0.65
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dry-run", action="store_true")
+    parser.add_argument("--config", default="recipes/finetune_vit_b16.yaml")
+    args = parser.parse_args()
+    for wd, lr in itertools.product(WEIGHT_DECAYS, LEARNING_RATES):
+        overrides = [
+            f"optim.weight_decay={wd}",
+            f"optim.learning_rate={lr}",
+            f"optim.layer_decay={LAYER_DECAY}",
+            f"run.name=ft_sweep_wd{wd}_lr{lr}",
+        ]
+        print("sweep:", overrides)
+        if not args.dry_run:
+            from jumbo_mae_tpu_tpu.cli.train import main as train_main
+
+            train_main(["--config", args.config, "--set", *overrides])
+
+
+if __name__ == "__main__":
+    main()

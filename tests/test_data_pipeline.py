@@ -1,0 +1,184 @@
+"""Data pipeline tests: shard algebra, tar streaming, loader contracts.
+
+Covers the contracts SURVEY §2.6 lists for the reference pipeline:
+process/worker disjoint striping, deterministic shuffles, repeat
+de-interleave, and the -1/valid eval padding consumed by the eval step.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.data import (
+    DataConfig,
+    TrainLoader,
+    batch_valid_samples,
+    expand_shards,
+    iter_tar_samples,
+    shuffle_shards,
+    split_shards,
+    train_sample_stream,
+    valid_loader,
+    valid_sample_stream,
+    write_tar_samples,
+)
+from jumbo_mae_tpu_tpu.data.tario import group_samples
+
+
+def _jpeg_bytes(rng: np.random.Generator, h=64, w=64) -> bytes:
+    from PIL import Image
+
+    img = Image.fromarray(rng.integers(0, 256, (h, w, 3), dtype=np.uint8), "RGB")
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG", quality=90)
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def shard_dir(tmp_path_factory):
+    """4 shards × 8 samples with jpg + cls members."""
+    root = tmp_path_factory.mktemp("shards")
+    rng = np.random.default_rng(0)
+    idx = 0
+    for s in range(4):
+        samples = []
+        for _ in range(8):
+            samples.append(
+                {
+                    "__key__": f"sample{idx:05d}",
+                    "jpg": _jpeg_bytes(rng),
+                    "cls": str(idx % 10).encode(),
+                }
+            )
+            idx += 1
+        write_tar_samples(str(root / f"train-{s:04d}.tar"), samples)
+    return root
+
+
+def test_expand_shards_brace_and_join():
+    urls = expand_shards("pre-{0000..0003}.tar")
+    assert urls == [f"pre-{i:04d}.tar" for i in range(4)]
+    urls = expand_shards("a.tar::b-{01..02}.tar")
+    assert urls == ["a.tar", "b-01.tar", "b-02.tar"]
+    assert expand_shards(["x", "y"]) == ["x", "y"]
+
+
+def test_shuffle_shards_deterministic_and_epoch_varying():
+    shards = [f"s{i}" for i in range(20)]
+    a = shuffle_shards(shards, seed=3, epoch=0)
+    b = shuffle_shards(shards, seed=3, epoch=0)
+    c = shuffle_shards(shards, seed=3, epoch=1)
+    assert a == b and sorted(a) == sorted(shards)
+    assert a != c and sorted(c) == sorted(shards)
+
+
+def test_split_shards_disjoint_cover():
+    shards = [f"s{i}" for i in range(13)]
+    seen = []
+    for p in range(2):
+        for w in range(3):
+            seen += split_shards(
+                shards, process_index=p, process_count=2, worker_index=w, worker_count=3
+            )
+    assert sorted(seen) == sorted(shards)
+    assert len(set(seen)) == len(seen)
+
+
+def test_tar_roundtrip_and_grouping(shard_dir):
+    samples = list(iter_tar_samples(str(shard_dir / "train-0000.tar")))
+    assert len(samples) == 8
+    assert {"__key__", "jpg", "cls"} <= set(samples[0])
+    assert samples[0]["__key__"] == "sample00000"
+
+
+def test_group_samples_multidot_extension():
+    members = [("d/a.jpg", b"1"), ("d/a.seg.png", b"2"), ("d/b.jpg", b"3")]
+    out = list(group_samples(iter(members)))
+    assert len(out) == 2
+    assert out[0]["seg.png"] == b"2"
+
+
+def test_corrupt_tar_skipped(tmp_path, shard_dir):
+    bad = tmp_path / "bad.tar"
+    bad.write_bytes(b"this is not a tar file at all" * 10)
+    assert list(iter_tar_samples(str(bad))) == []
+    # and a missing shard doesn't raise either
+    assert list(iter_tar_samples(str(tmp_path / "missing.tar"))) == []
+
+
+def _cfg(shard_dir, **kw):
+    defaults = dict(
+        train_shards=str(shard_dir / "train-{0000..0003}.tar"),
+        valid_shards=str(shard_dir / "train-{0000..0003}.tar"),
+        image_size=32,
+        workers=0,
+        shuffle_buffer=8,
+        seed=7,
+    )
+    defaults.update(kw)
+    return DataConfig(**defaults)
+
+
+def test_train_stream_deterministic(shard_dir):
+    cfg = _cfg(shard_dir)
+    a = [x for x, _ in zip(train_sample_stream(cfg), range(10))]
+    b = [x for x, _ in zip(train_sample_stream(cfg), range(10))]
+    for (ia, la), (ib, lb) in zip(a, b):
+        assert la == lb
+        np.testing.assert_array_equal(ia, ib)
+    assert a[0][0].shape == (32, 32, 3) and a[0][0].dtype == np.uint8
+
+
+def test_train_stream_process_split_disjoint_labels(shard_dir):
+    cfg = _cfg(shard_dir, shuffle_buffer=0)
+    # 2 processes: each sees only its stripe's shards in epoch 0
+    keys0 = {l for (_, l), _ in zip(
+        train_sample_stream(cfg, process_index=0, process_count=2), range(16)
+    )}
+    keys1 = {l for (_, l), _ in zip(
+        train_sample_stream(cfg, process_index=1, process_count=2), range(16)
+    )}
+    assert keys0 and keys1  # both streams produce data
+
+
+def test_train_loader_batches_and_repeats(shard_dir):
+    cfg = _cfg(shard_dir, repeats=2)
+    loader = TrainLoader(cfg, batch_size=8)
+    batch = next(loader)
+    assert batch["images"].shape == (8, 32, 32, 3)
+    assert batch["images"].dtype == np.uint8
+    assert batch["labels"].shape == (8,)
+    # repeated augmentation: each source sample contributes `repeats` clones,
+    # de-interleaved: clone pairs are batch[i] and batch[i + B//2]
+    assert list(batch["labels"][:4]) == list(batch["labels"][4:])
+
+
+def test_valid_loader_pad_contract(shard_dir):
+    cfg = _cfg(shard_dir)
+    batches = list(valid_loader(cfg, batch_size=5))
+    # 32 samples → 6 batches of 5, last has 2 valid
+    assert len(batches) == 7
+    for b in batches:
+        assert b["images"].shape == (5, 32, 32, 3)
+    assert b["valid"].sum() == 2
+    assert (b["labels"][~b["valid"]] == -1).all()
+    total = sum(b["valid"].sum() for b in batches)
+    assert total == 32
+
+
+def test_valid_stream_covers_everything_once(shard_dir):
+    cfg = _cfg(shard_dir)
+    labels = [l for _, l in valid_sample_stream(cfg)]
+    assert len(labels) == 32
+
+
+def test_multiprocess_workers(shard_dir):
+    cfg = _cfg(shard_dir, workers=2, prefetch_batches=2)
+    loader = TrainLoader(cfg, batch_size=4)
+    try:
+        for _ in range(4):
+            batch = next(loader)
+            assert batch["images"].shape == (4, 32, 32, 3)
+    finally:
+        loader.close()

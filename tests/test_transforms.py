@@ -1,0 +1,125 @@
+"""Augmentation tests: distribution bounds, determinism, policy grammar."""
+
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.data.randaugment import (
+    AugMix,
+    AutoAugment,
+    RandAugment,
+    auto_augment_factory,
+)
+from jumbo_mae_tpu_tpu.data.transforms import (
+    adjust_brightness,
+    center_crop,
+    color_jitter,
+    eval_transform,
+    random_erasing,
+    random_hflip,
+    random_resized_crop,
+    resize,
+    simple_resize_crop,
+)
+
+
+def _img(h=48, w=64, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, (h, w, 3), dtype=np.uint8)
+
+
+def test_resize_and_center_crop_shapes():
+    img = _img()
+    assert resize(img, (32, 32)).shape == (32, 32, 3)
+    assert center_crop(img, 32).shape == (32, 32, 3)
+    assert center_crop(_img(16, 16), 32).shape == (32, 32, 3)  # pad-to-fit
+
+
+def test_eval_transform_matches_reference_geometry():
+    # 224 target, crop ratio 0.875 → resize shorter side to 256 then crop
+    out = eval_transform(_img(300, 400), 224, crop_ratio=0.875)
+    assert out.shape == (224, 224, 3)
+
+
+def test_random_resized_crop_deterministic_and_shaped():
+    img = _img()
+    a = random_resized_crop(np.random.default_rng(5), img, 32)
+    b = random_resized_crop(np.random.default_rng(5), img, 32)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (32, 32, 3) and a.dtype == np.uint8
+
+
+def test_src_mode_pads_and_crops():
+    out = simple_resize_crop(np.random.default_rng(0), _img(), 32)
+    assert out.shape == (32, 32, 3)
+
+
+def test_hflip_probability_extremes():
+    img = _img()
+    np.testing.assert_array_equal(random_hflip(np.random.default_rng(0), img, 0.0), img)
+    np.testing.assert_array_equal(
+        random_hflip(np.random.default_rng(0), img, 1.0), img[:, ::-1]
+    )
+
+
+def test_brightness_identity_and_black():
+    img = _img()
+    np.testing.assert_array_equal(adjust_brightness(img, 1.0), img)
+    assert adjust_brightness(img, 0.0).max() == 0
+
+
+def test_color_jitter_zero_strength_is_identity():
+    img = _img()
+    np.testing.assert_array_equal(color_jitter(np.random.default_rng(0), img, 0.0), img)
+
+
+def test_random_erasing_probability_and_noise():
+    img = _img()
+    np.testing.assert_array_equal(random_erasing(np.random.default_rng(0), img, 0.0), img)
+    out = random_erasing(np.random.default_rng(0), img, 1.0)
+    assert out.shape == img.shape
+    assert (out != img).any()  # some rect was erased
+    # input not mutated
+    np.testing.assert_array_equal(img, _img())
+
+
+def test_randaugment_runs_and_is_deterministic():
+    aug = RandAugment(magnitude=9, num_layers=2, mstd=0.5, increasing=True)
+    img = _img()
+    a = aug(np.random.default_rng(3), img)
+    b = aug(np.random.default_rng(3), img)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == img.shape and a.dtype == np.uint8
+
+
+def test_augmix_and_autoaugment_run():
+    img = _img()
+    out = AugMix(magnitude=3, width=3)(np.random.default_rng(0), img)
+    assert out.shape == img.shape and out.dtype == np.uint8
+    out = AutoAugment()(np.random.default_rng(0), img)
+    assert out.shape == img.shape
+
+
+def test_policy_grammar():
+    ra = auto_augment_factory("rand-m9-mstd0.5-inc1")
+    assert isinstance(ra, RandAugment)
+    assert ra.magnitude == 9 and ra.mstd == 0.5 and ra.increasing
+    am = auto_augment_factory("augmix-m3-w4-d2")
+    assert isinstance(am, AugMix) and am.width == 4 and am.depth == 2
+    assert isinstance(auto_augment_factory("original"), AutoAugment)
+    assert auto_augment_factory("none") is None
+    assert auto_augment_factory("") is None
+    with pytest.raises(ValueError):
+        auto_augment_factory("rand-__bogus__")
+
+
+def test_all_randaugment_ops_apply_at_extremes():
+    """Every op in the table must run at level 0 and 10 without error."""
+    from jumbo_mae_tpu_tpu.data.randaugment import _OPS, _apply_op
+    from PIL import Image
+
+    pil = Image.fromarray(_img())
+    rng = np.random.default_rng(0)
+    for name in _OPS:
+        for level in (0.0, 10.0):
+            for inc in (False, True):
+                out = _apply_op(pil, name, rng, level, 0.0, inc)
+                assert out.size == pil.size

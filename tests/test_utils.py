@@ -1,0 +1,89 @@
+"""Tests for meters, MFU math, and the metric logger."""
+
+import json
+
+import numpy as np
+
+from jumbo_mae_tpu_tpu.models import preset
+from jumbo_mae_tpu_tpu.models.config import DecoderConfig
+from jumbo_mae_tpu_tpu.utils import (
+    AverageMeter,
+    MetricLogger,
+    StepTimer,
+    classify_flops_per_image,
+    encoder_flops_per_image,
+    mfu_report,
+    pretrain_flops_per_image,
+)
+
+
+def test_average_meter_means_and_latest():
+    m = AverageMeter()
+    m.update({"loss": 1.0, "learning_rate": 0.1})
+    m.update({"loss": 3.0, "learning_rate": 0.2})
+    out = m.summary("train/")
+    assert out["train/loss"] == 2.0
+    assert out["train/learning_rate"] == 0.2
+    assert m.summary() == {}  # buffer cleared
+
+
+def test_average_meter_accepts_arrays():
+    m = AverageMeter()
+    m.update({"loss": np.float32(2.5)})
+    assert m.summary()["loss"] == 2.5
+
+
+def test_flops_masked_encoder_cheaper():
+    cfg = preset("vit_b16", mask_ratio=0.75, labels=None)
+    masked = encoder_flops_per_image(cfg, masked=True)
+    full = encoder_flops_per_image(cfg, masked=False)
+    assert masked < 0.5 * full  # 75% masking cuts well over half the FLOPs
+    assert masked > 0
+
+
+def test_pretrain_flops_vs_known_scale():
+    """ViT-L/16 MAE fwd+bwd should land in the right order of magnitude
+    (~100 GFLOPs/image: ViT-L full fwd is ~62 GFLOPs; masked enc + 8×512
+    decoder fwd ≈ 33 GFLOPs, ×3 for training)."""
+    enc = preset("vit_l16", mask_ratio=0.75, labels=None)
+    dec = DecoderConfig(layers=8, dim=512, heads=16)
+    flops = pretrain_flops_per_image(enc, dec, training=True)
+    assert 5e10 < flops < 3e11
+
+
+def test_classify_flops_includes_head():
+    with_head = classify_flops_per_image(preset("vit_b16", labels=1000))
+    without = classify_flops_per_image(preset("vit_b16", labels=None))
+    assert with_head > without
+
+
+def test_mfu_report_math():
+    r = mfu_report(1e12, 100.0, peak_tflops=200.0)
+    assert np.isclose(r.achieved_tflops, 100.0)
+    assert np.isclose(r.mfu, 0.5)
+
+
+def test_metric_logger_jsonl(tmp_path):
+    logger = MetricLogger(tmp_path, name="t", config={"a": 1}, use_wandb=False)
+    logger.log({"loss": 1.5}, step=3)
+    logger.log({"loss": 2.5}, step=4)
+    logger.close()
+    lines = (tmp_path / "t-metrics.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["step"] == 3 and rec["loss"] == 1.5
+    assert json.loads((tmp_path / "t-config.json").read_text()) == {"a": 1}
+
+
+def test_metric_logger_disabled(tmp_path):
+    logger = MetricLogger(tmp_path, name="off", enabled=False)
+    logger.log({"x": 1})
+    logger.close()
+    assert not (tmp_path / "off-metrics.jsonl").exists()
+
+
+def test_step_timer():
+    t = StepTimer(warmup_steps=1)
+    for _ in range(5):
+        t.tick()
+    assert t.steps_per_sec is not None and t.steps_per_sec > 0

@@ -1,0 +1,59 @@
+#!/usr/bin/env python3
+"""Offline jumbo checkpoint converter (flax ↔ PyTorch).
+
+Replaces the reference's stale plain-ViT converters
+(``/root/reference/scripts/convert_flax_to_pytorch.py``,
+``convert_pytorch_to_flax.py`` — SURVEY defect #4) with ones that understand
+the jumbo layout.
+
+    python tools/convert_checkpoint.py to-torch  ckpt.msgpack out.pth
+    python tools/convert_checkpoint.py to-torch  runs/x/ckpt   out.pth
+    python tools/convert_checkpoint.py to-flax   in.pth out.msgpack --heads 12
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    tt = sub.add_parser("to-torch")
+    tt.add_argument("src", help=".msgpack params file or Orbax ckpt directory")
+    tt.add_argument("dst", help="output .pth path")
+    tf = sub.add_parser("to-flax")
+    tf.add_argument("src", help="input .pth state-dict path")
+    tf.add_argument("dst", help="output .msgpack path")
+    tf.add_argument("--heads", type=int, required=True, help="attention heads")
+    args = parser.parse_args()
+
+    import torch
+
+    from jumbo_mae_tpu_tpu.interop import flax_to_torch_state, torch_to_flax_params
+    from jumbo_mae_tpu_tpu.train.checkpoint import (
+        export_params_msgpack,
+        import_params_msgpack,
+        restore_params_any,
+    )
+
+    if args.cmd == "to-torch":
+        src = Path(args.src)
+        params = (
+            restore_params_any(src) if src.is_dir() else import_params_msgpack(src)
+        )
+        state = flax_to_torch_state(params)
+        torch.save({k: torch.from_numpy(v.copy()) for k, v in state.items()}, args.dst)
+        print(f"wrote {len(state)} tensors → {args.dst}")
+    else:
+        sd = torch.load(args.src, map_location="cpu", weights_only=True)
+        sd = {k: v.numpy() for k, v in sd.items()}
+        tree = torch_to_flax_params(sd, heads=args.heads)
+        tree.pop("__batch_stats__", None)
+        export_params_msgpack({"model": tree}, args.dst)
+        print(f"wrote flax params → {args.dst}")
+
+
+if __name__ == "__main__":
+    main()
