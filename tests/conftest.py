@@ -18,6 +18,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (may already be imported by sitecustomize)
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: the suite's cost is XLA compiles of tiny
+# train steps, which are identical run-to-run — cache them across processes.
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.join(_repo_root, ".jax_cache")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import pytest  # noqa: E402
 
