@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 Posemb = Literal["learnable", "sincos2d"]
 Pooling = Literal["cls", "gap"]
-AttnImpl = Literal["einsum", "flash", "auto"]
+AttnImpl = Literal["einsum", "flash", "ring", "auto"]
 MaskModeT = Literal["shared", "per_sample"]
 
 

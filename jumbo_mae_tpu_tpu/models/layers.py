@@ -55,8 +55,9 @@ class Attention(nn.Module):
         k = dense("k")(x)
         v = dense("v")(x)
 
-        # The flash path has no attention-probability dropout; any dropout>0
-        # must take the einsum path so training semantics don't silently change.
+        # The flash/ring paths have no attention-probability dropout; any
+        # dropout>0 must take the einsum path so training semantics don't
+        # silently change.
         use_flash = cfg.dropout == 0.0 and (
             cfg.attn_impl == "flash"
             or (
@@ -65,7 +66,23 @@ class Attention(nn.Module):
                 and q.shape[1] >= 256
             )
         )
-        if use_flash:
+        if cfg.attn_impl == "ring" and cfg.dropout > 0.0:
+            # Unlike "auto"→flash (a speed choice), "ring" is an explicit
+            # parallelism request; silently degrading to O(S²) per-device
+            # attention would defeat it — fail loudly instead.
+            raise ValueError(
+                "attn_impl='ring' has no attention-probability dropout; "
+                "set dropout=0.0 (droppath regularization still applies)"
+            )
+        if cfg.attn_impl == "ring":
+            # Sequence parallelism: tokens shard over the ambient mesh's
+            # "seq" axis, K/V ring-rotate over ICI (parallel/ring_attention).
+            from jumbo_mae_tpu_tpu.parallel.ring_attention import (
+                ring_self_attention,
+            )
+
+            z = ring_self_attention(q, k, v)
+        elif use_flash:
             from jumbo_mae_tpu_tpu.ops.flash_attention import flash_attention
 
             z = flash_attention(q, k, v)

@@ -2,6 +2,7 @@ from jumbo_mae_tpu_tpu.parallel.mesh import MeshConfig, create_mesh
 from jumbo_mae_tpu_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_sharded,
+    ring_self_attention,
 )
 from jumbo_mae_tpu_tpu.parallel.sharding import (
     batch_sharding,
@@ -16,6 +17,7 @@ __all__ = [
     "infer_state_sharding",
     "ring_attention",
     "ring_attention_sharded",
+    "ring_self_attention",
     "shard_param_spec",
 ]
 

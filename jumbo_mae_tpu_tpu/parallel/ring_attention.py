@@ -23,7 +23,6 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
 
 NEG_INF = -1e30
 
@@ -32,14 +31,18 @@ def ring_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    kv_mask: jax.Array | None = None,
     *,
     axis_name: str,
 ) -> jax.Array:
     """Online-softmax attention with K/V ring rotation over ``axis_name``.
 
     Shapes (per shard): (batch, local_seq, heads, head_dim); queries
-    pre-scaled. Must run inside ``shard_map``/``pmap`` with ``axis_name``
-    bound. Returns the local query block's exact global attention output.
+    pre-scaled. ``kv_mask`` is an optional (batch, local_seq) bool marking
+    which local *keys* are real — it rotates around the ring with its K/V
+    block, so padded tokens (uneven sequence splits) never receive weight.
+    Must run inside ``shard_map``/``pmap`` with ``axis_name`` bound. Returns
+    the local query block's exact global attention output.
     """
     n = jax.lax.psum(1, axis_name)
     bq, sq, h, d = q.shape
@@ -48,16 +51,27 @@ def ring_attention(
     l0 = jnp.zeros((bq, h, sq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, sq, h, d), jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    masked = kv_mask is not None
+    # The bias joins the scan carry and ring-rotates with its K/V block —
+    # only pay that extra ppermute when a mask actually exists.
+    bias0 = (
+        jnp.where(kv_mask, 0.0, NEG_INF)[:, None, None, :] if masked else None
+    )  # (b,1,1,k)
 
     def hop(carry, _):
-        m, l, acc, k_cur, v_cur = carry
+        m, l, acc, k_cur, v_cur, bias = carry
         # issue the rotation FIRST so the transfer overlaps this block's math
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        bias_nxt = (
+            jax.lax.ppermute(bias, axis_name, perm) if masked else None
+        )
 
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k_cur, preferred_element_type=jnp.float32
         )
+        if masked:
+            s = s + bias
         m_new = jnp.maximum(m, s.max(-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -68,9 +82,11 @@ def ring_attention(
             v_cur,
             preferred_element_type=jnp.float32,
         )
-        return (m_new, l, acc, k_nxt, v_nxt), None
+        return (m_new, l, acc, k_nxt, v_nxt, bias_nxt), None
 
-    (m, l, acc, _, _), _ = jax.lax.scan(hop, (m0, l0, acc0, k, v), None, length=n)
+    (m, l, acc, *_), _ = jax.lax.scan(
+        hop, (m0, l0, acc0, k, v, bias0), None, length=n
+    )
     return (acc / l.transpose(0, 2, 1, 3)).astype(q.dtype)
 
 
@@ -85,13 +101,63 @@ def ring_attention_sharded(
 ) -> jax.Array:
     """shard_map wrapper: global (B, S, H, D) inputs with S sharded over
     ``seq_axis`` (and batch over ``batch_axes``); emits the identically
-    sharded attention output."""
+    sharded attention output. S must divide evenly — use
+    :func:`ring_self_attention` for arbitrary lengths."""
     spec = P(tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None, seq_axis)
-    fn = shard_map(
+    fn = jax.shard_map(
         partial(ring_attention, axis_name=seq_axis),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v)
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+) -> jax.Array:
+    """Sequence-parallel self-attention over the *ambient* mesh, for use
+    inside model code under ``jit`` (activate the mesh with
+    ``jax.sharding.set_mesh``). Handles sequence lengths that don't divide
+    the ``seq`` axis by zero-padding K/V and masking the pad keys (the mask
+    ring-rotates with its block). Falls back to plain attention when no
+    ambient mesh is active or its ``seq`` axis is trivial.
+
+    q, k, v: (batch, seq, heads, head_dim), queries pre-scaled.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    n = mesh.shape.get(seq_axis, 1) if mesh is not None else 1
+    if not n or n <= 1:
+        from jumbo_mae_tpu_tpu.ops.flash_attention import xla_attention
+
+        return xla_attention(q, k, v)
+
+    b, s, h, d = q.shape
+    s_pad = -(-s // n) * n
+    pad = s_pad - s
+    bspec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    qkv_spec = P(bspec, seq_axis, None, None)
+    if not pad:
+        out = jax.shard_map(
+            partial(ring_attention, axis_name=seq_axis),
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )(q, k, v)
+        return out
+    widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+    q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
+    kv_mask = jnp.broadcast_to(jnp.arange(s_pad) < s, (b, s_pad))
+    out = jax.shard_map(
+        partial(ring_attention, axis_name=seq_axis),
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(bspec, seq_axis)),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )(q, k, v, kv_mask)
+    return out[:, :s]

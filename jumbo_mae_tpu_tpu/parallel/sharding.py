@@ -1,11 +1,29 @@
 """Sharding rules: how arrays are laid out over the mesh.
 
-FSDP (ZeRO-3-style) parameter sharding is a *rule*, not a hand-written table:
-every array in the train state gets its largest axis divisible by the ``fsdp``
-axis size sharded, provided the array is big enough to be worth scattering
-(``min_shard_size``). Scalars, norms, biases and other small tensors stay
-replicated. Optimizer moments follow their parameters automatically because
-the rule is applied to the whole state pytree by shape.
+Two rule families compose per array, in priority order:
+
+**Tensor parallel (Megatron-style), ``tensor`` axis.** Matched by parameter
+*path* — the contraction structure of each layer decides which dim shards:
+
+- attention ``q/k/v`` kernels ``(dim, heads, head_dim)`` shard the *heads*
+  dim (and their ``(heads, head_dim)`` biases likewise), so every device
+  computes a disjoint subset of heads;
+- the attention ``out`` kernel ``(heads, head_dim, dim)`` shards heads on
+  input — its matmul contracts the sharded dim, which is what makes GSPMD
+  emit the single per-block all-reduce of Megatron TP;
+- MLP ``fc1`` ``(dim, hidden)`` shards *hidden* on output (bias too),
+  ``fc2`` ``(hidden, dim)`` shards *hidden* on input — same
+  column-then-row-parallel pairing.
+
+**FSDP (ZeRO-3-style), ``fsdp`` axis.** A *shape* rule: the largest
+still-unsharded axis divisible by the ``fsdp`` size is scattered, provided
+the array is big enough to be worth it (``min_shard_size``). Scalars, norms
+and other small tensors stay replicated.
+
+Optimizer moments follow their parameters automatically because the rules
+are applied to the whole train-state pytree and matched on the *trailing*
+path components (``.../attn/q/kernel`` matches inside ``opt_state...mu`` the
+same way it matches inside ``params``).
 
 The batch is sharded over (data, fsdp) on its leading axis, so the product of
 both axes is the total data-parallel degree — fsdp devices see distinct
@@ -21,6 +39,50 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Modules whose parameters carry a tensor-parallel dim, keyed by the
+# (parent-module, leaf) tail of the parameter path. Values: which dim of the
+# kernel/bias shards. q/k/v kernels are (dim, heads, head_dim) DenseGeneral
+# kernels; fc kernels are plain (in, out) Dense kernels.
+_TP_KERNEL_DIM = {"q": 1, "k": 1, "v": 1, "out": 0, "fc1": 1, "fc2": 0}
+# Biases shard only where the *output* of the matmul is sharded (column
+# parallel): q/k/v bias (heads, head_dim) dim 0, fc1 bias (hidden,) dim 0.
+_TP_BIAS_DIM = {"q": 0, "k": 0, "v": 0, "fc1": 0}
+
+
+def _path_names(path) -> list[str]:
+    out = []
+    for k in path:
+        name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "name", None)
+        out.append(str(name) if name is not None else str(k))
+    return out
+
+
+def tensor_dim(path, shape: tuple[int, ...], tp_size: int) -> int | None:
+    """Which dim of this parameter shards over the ``tensor`` axis, or None.
+
+    Matched on the trailing (module, leaf) path names so the rule applies
+    identically under ``params`` and under optimizer-moment subtrees.
+    """
+    if tp_size <= 1 or len(path) < 2:
+        return None
+    names = _path_names(path[-2:])
+    parent, leaf = names[0], names[1]
+    table = (
+        _TP_KERNEL_DIM
+        if leaf == "kernel"
+        else _TP_BIAS_DIM
+        if leaf == "bias"
+        else None
+    )
+    if table is None or parent not in table:
+        return None
+    dim = table[parent]
+    if dim >= len(shape) or shape[dim] % tp_size:
+        return None
+    return dim
+
 
 def shard_param_spec(
     shape: tuple[int, ...],
@@ -28,19 +90,29 @@ def shard_param_spec(
     *,
     axis: str = "fsdp",
     min_shard_size: int = 2**16,
+    path=(),
+    tensor_axis: str = "tensor",
 ) -> P:
-    """Choose a PartitionSpec for one array: shard the largest divisible dim
-    on ``axis``, or replicate if too small / nothing divides."""
+    """Compose the TP rule (path-based) with the FSDP rule (shape-based)."""
+    spec: list = [None] * len(shape)
+
+    tp_size = mesh.shape.get(tensor_axis, 1)
+    tp_dim = tensor_dim(path, shape, tp_size)
+    if tp_dim is not None:
+        spec[tp_dim] = tensor_axis
+
     size = mesh.shape[axis]
-    if size <= 1 or int(np.prod(shape)) < min_shard_size:
-        return P()
-    candidates = [i for i, d in enumerate(shape) if d % size == 0]
-    if not candidates:
-        return P()
-    dim = max(candidates, key=lambda i: shape[i])
-    spec = [None] * len(shape)
-    spec[dim] = axis
-    return P(*spec)
+    if size > 1 and int(np.prod(shape)) >= min_shard_size:
+        candidates = [
+            i
+            for i, d in enumerate(shape)
+            if spec[i] is None and d % size == 0
+        ]
+        if candidates:
+            dim = max(candidates, key=lambda i: shape[i])
+            spec[dim] = axis
+
+    return P(*spec) if any(s is not None for s in spec) else P()
 
 
 def infer_state_sharding(
@@ -53,16 +125,20 @@ def infer_state_sharding(
     """Map a pytree of ShapeDtypeStructs (from ``jax.eval_shape``) to
     NamedShardings using :func:`shard_param_spec` per leaf."""
 
-    def leaf_sharding(leaf):
+    def leaf_sharding(path, leaf):
         shape = getattr(leaf, "shape", ())
         return NamedSharding(
             mesh,
             shard_param_spec(
-                tuple(shape), mesh, axis=axis, min_shard_size=min_shard_size
+                tuple(shape),
+                mesh,
+                axis=axis,
+                min_shard_size=min_shard_size,
+                path=path,
             ),
         )
 
-    return jax.tree_util.tree_map(leaf_sharding, state_shapes)
+    return jax.tree_util.tree_map_with_path(leaf_sharding, state_shapes)
 
 
 def batch_sharding(

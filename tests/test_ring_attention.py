@@ -7,7 +7,10 @@ import pytest
 
 from jumbo_mae_tpu_tpu.ops.flash_attention import xla_attention
 from jumbo_mae_tpu_tpu.parallel import MeshConfig, create_mesh
-from jumbo_mae_tpu_tpu.parallel.ring_attention import ring_attention_sharded
+from jumbo_mae_tpu_tpu.parallel.ring_attention import (
+    ring_attention_sharded,
+    ring_self_attention,
+)
 
 
 def _qkv(b=2, s=64, h=4, d=8, seed=0):
@@ -48,6 +51,49 @@ def test_ring_gradients_match(devices):
     g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ring, g_full):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5)
+
+
+@pytest.mark.parametrize("s", [19, 197])
+def test_ring_self_attention_uneven_seq(devices, s):
+    """Ambient-mesh wrapper pads odd sequence lengths and masks pad keys."""
+    mesh = create_mesh(MeshConfig(data=2, fsdp=1, seq=4))
+    q, k, v = _qkv(b=4, s=s)
+    expected = xla_attention(q, k, v)
+    with jax.sharding.set_mesh(mesh):
+        out = jax.jit(ring_self_attention)(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_self_attention_no_mesh_fallback():
+    """Without an ambient mesh (or with seq=1) it degrades to xla_attention."""
+    q, k, v = _qkv(s=16)
+    out = ring_self_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(xla_attention(q, k, v)), rtol=1e-6
+    )
+
+
+def test_vit_forward_ring_equals_einsum(devices):
+    """Full Jumbo ViT forward with attn_impl='ring' under a seq-sharded mesh
+    must match the einsum implementation (uneven 3+16-token sequence)."""
+    from jumbo_mae_tpu_tpu.models import JumboViT, preset
+
+    mesh = create_mesh(MeshConfig(data=2, fsdp=1, seq=4))
+    images = jnp.asarray(
+        np.random.default_rng(0).integers(0, 255, (4, 32, 32, 3)), jnp.float32
+    ) / 255.0
+    cfg = preset("vit_t16", image_size=32, patch_size=8, labels=10, dtype="float32")
+    model_ein = JumboViT(cfg.replace(attn_impl="einsum"))
+    params = model_ein.init(jax.random.key(0), images)
+    want = model_ein.apply(params, images)
+    model_ring = JumboViT(cfg.replace(attn_impl="ring"))
+    with jax.sharding.set_mesh(mesh):
+        got = jax.jit(model_ring.apply)(params, images)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
 
 
 def test_ring_long_sequence_jit(devices):
