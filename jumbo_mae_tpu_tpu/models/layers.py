@@ -63,7 +63,12 @@ class Attention(nn.Module):
             or (
                 cfg.attn_impl == "auto"
                 and jax.default_backend() == "tpu"
-                and q.shape[1] >= 256
+                # ≥128 tokens: the fused kernel avoids materializing the
+                # (B,H,S,S) float32 score tensor. At the MAE decoder's S=199
+                # that's a measured speed wash but an O(S²)→O(S) memory win
+                # (PERF.md §decisions); below 128 the padding waste makes
+                # einsum strictly better.
+                and q.shape[1] >= 128
             )
         )
         if cfg.attn_impl == "ring" and cfg.dropout > 0.0:

@@ -41,7 +41,7 @@ def flash_attention(
     q, k, v: (batch, seq, heads, head_dim). Returns the same shape as q.
     """
     seq_q, seq_k = q.shape[1], k.shape[1]
-    if jax.default_backend() != "tpu" or seq_q % block_q or seq_k % block_k:
+    if jax.default_backend() != "tpu":
         if max(seq_q, seq_k) >= 2048:
             from jumbo_mae_tpu_tpu.ops.blockwise_attention import (
                 blockwise_attention,

@@ -27,7 +27,7 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, valid_k: int):
     q = q_ref[0].astype(jnp.float32)  # (block_q, d)
     block_q, d = q.shape
     seq_k = k_ref.shape[1]
@@ -39,6 +39,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int):
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (block_q, block_k)
+        if valid_k != seq_k:
+            # keys beyond valid_k are zero-padding (ragged seq support):
+            # force their scores to -inf so they get zero softmax weight.
+            col = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(col < valid_k, s, NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -55,27 +62,48 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int):
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
+def _pad_seq(x, to: int):
+    pad = to - x.shape[1]
+    if not pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+
+def _round_up(x: int, to: int) -> int:
+    return -(-x // to) * to
+
+
 def _flash_fwd(q, k, v, block_q, block_k, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    # Clamp blocks for short sequences (one right-sized 128-multiple block),
+    # then pad ragged lengths to block multiples; pad *keys* are masked
+    # inside the kernel (valid_k), pad *query* rows compute garbage that is
+    # sliced off below (they still see ≥1 real key, so no 0/0).
+    block_q = min(block_q, _round_up(sq, 128))
+    block_k = min(block_k, _round_up(sk, 128))
+    sq_pad = _round_up(sq, block_q)
+    sk_pad = _round_up(sk, block_k)
+    q, k, v = _pad_seq(q, sq_pad), _pad_seq(k, sk_pad), _pad_seq(v, sk_pad)
     # fold heads into the grid's batch dim: (B*H, S, D)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq_pad, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk_pad, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk_pad, d)
 
     out = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_k=block_k),
-        grid=(b * h, sq // block_q),
+        functools.partial(_fwd_kernel, block_k=block_k, valid_k=sk),
+        grid=(b * h, sq_pad // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, sq_pad, d).transpose(0, 2, 1, 3)
+    return out[:, :sq] if sq_pad != sq else out
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -89,9 +117,10 @@ def pallas_flash_attention(
 ) -> jax.Array:
     """Flash attention over (batch, seq, heads, head_dim); q pre-scaled.
 
-    ``seq_q % block_q == 0`` and ``seq_k % block_k == 0`` are required —
-    callers (``ops/flash_attention.py``) fall back to XLA otherwise.
-    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
+    Arbitrary sequence lengths: inputs are padded to block multiples and the
+    pad keys are masked to -inf inside the kernel (MAE shapes like 199 are
+    first-class). ``interpret=True`` runs the kernel in the Pallas
+    interpreter (CPU tests).
     """
     return _flash_fwd(q, k, v, block_q, block_k, interpret)
 

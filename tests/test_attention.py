@@ -77,6 +77,31 @@ class TestPallasKernel:
             np.asarray(got, np.float32), np.asarray(ref, np.float32), atol=atol
         )
 
+    @pytest.mark.parametrize("s", [199, 55, 130])
+    def test_forward_ragged_seq_interpret(self, s):
+        """MAE shapes (decoder 196+3, encoder 49+3·…) don't divide the block:
+        the kernel pads internally and masks pad keys."""
+        q, k, v = qkv(s=s, d=32)
+        ref = xla_attention(q, k, v)
+        got = pallas_flash_attention(q, k, v, 128, 128, True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5
+        )
+
+    def test_backward_ragged_seq(self):
+        q, k, v = qkv(s=199, d=32)
+
+        def loss(q, k, v):
+            return (pallas_flash_attention(q, k, v, 128, 128, True) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (xla_attention(q, k, v) ** 2).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
     def test_backward_via_blockwise(self):
         q, k, v = qkv(s=128, d=128)
 
