@@ -19,7 +19,14 @@ import time
 import numpy as np
 
 
-def build_step(dtype: str, batch_size: int):
+MODELS = {
+    # preset, decoder, default batch, remat
+    "vit_l16": ("vit_l16", dict(layers=8, dim=512, heads=16), 128, False),
+    "vit_h14": ("vit_h14", dict(layers=8, dim=512, heads=16), 32, True),
+}
+
+
+def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
     import jax
 
     from jumbo_mae_tpu_tpu.models import DecoderConfig, MAEPretrainModel, preset
@@ -35,13 +42,19 @@ def build_step(dtype: str, batch_size: int):
         make_train_step,
     )
 
+    model_name, dec_kw, _, remat = MODELS[model]
     mesh = create_mesh(
         MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1]
     )
     enc = preset(
-        "vit_l16", mask_ratio=0.75, labels=None, posemb="sincos2d", dtype=dtype
+        model_name,
+        mask_ratio=0.75,
+        labels=None,
+        posemb="sincos2d",
+        dtype=dtype,
+        grad_ckpt=remat,
     )
-    dec = DecoderConfig(layers=8, dim=512, heads=16, dtype=dtype)
+    dec = DecoderConfig(**dec_kw, dtype=dtype)
     module = MAEPretrainModel(enc, dec, norm_pix_loss=True)
 
     batch = {
@@ -85,10 +98,15 @@ def time_steps(step, state, batch, *, warmup: int, iters: int) -> float:
 
 
 def main():
-    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
+    model = os.environ.get("BENCH_MODEL", "vit_l16")
+    if model not in MODELS:
+        raise SystemExit(
+            f"unknown BENCH_MODEL {model!r}; choose from {sorted(MODELS)}"
+        )
+    batch_size = int(os.environ.get("BENCH_BATCH", str(MODELS[model][2])))
     iters = int(os.environ.get("BENCH_ITERS", "10"))
 
-    step, state, batch = build_step("bfloat16", batch_size)
+    step, state, batch = build_step("bfloat16", batch_size, model)
     dt = time_steps(step, state, batch, warmup=3, iters=iters)
     imgs_per_sec = batch_size / dt
     del step, state
@@ -97,14 +115,14 @@ def main():
     if baseline_env:
         ratio = float("nan")
     else:
-        step_f32, state_f32, batch = build_step("float32", batch_size)
+        step_f32, state_f32, batch = build_step("float32", batch_size, model)
         dt_f32 = time_steps(step_f32, state_f32, batch, warmup=2, iters=max(4, iters // 2))
         ratio = (batch_size / dt_f32) and imgs_per_sec / (batch_size / dt_f32)
 
     print(
         json.dumps(
             {
-                "metric": "mae_vit_l16_224_pretrain_imgs_per_sec_per_chip",
+                "metric": f"mae_{model}_224_pretrain_imgs_per_sec_per_chip",
                 "value": round(imgs_per_sec, 2),
                 "unit": "imgs/sec/chip",
                 "vs_baseline": round(ratio, 3) if ratio == ratio else None,

@@ -5,10 +5,11 @@ The reference materializes full (B,H,N,N) score tensors
 long-context. This module provides ``flash_attention(q, k, v)`` over
 (B, N, H, D) tensors:
 
-- on TPU, a Pallas blockwise-softmax kernel (``pallas_impl``) that never
-  materializes the N×N score matrix in HBM;
-- elsewhere (or for shapes below the kernel's tile granularity), an XLA
-  fallback that is numerically identical to the naive path.
+- on TPU, a Pallas blockwise-softmax kernel (``ops/pallas/attention.py``)
+  that never materializes the N×N score matrix in HBM — any sequence length
+  (the kernel pads to lane tiles and masks pad keys internally);
+- elsewhere, an XLA fallback that is numerically identical to the naive
+  path (blockwise-chunked above 2048 tokens).
 
 Inputs are expected pre-scaled (queries already multiplied by head_dim**-0.5,
 matching the callers in ``models/layers.py``).

@@ -73,17 +73,34 @@ def _round_up(x: int, to: int) -> int:
     return -(-x // to) * to
 
 
+def _largest_dividing_block(requested: int, seq_pad: int) -> int:
+    """Largest block ≤ requested that divides ``seq_pad``. Production blocks
+    stay on 128 multiples (seq_pad is one, so 128 always qualifies);
+    sub-128 requests (interpreter tests) fall back to any exact divisor."""
+    block = min(requested, seq_pad)
+    if block >= 128:
+        block = block // 128 * 128
+        while seq_pad % block:
+            block -= 128
+    else:
+        while seq_pad % block:
+            block -= 1
+    return block
+
+
 def _flash_fwd(q, k, v, block_q, block_k, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    # Clamp blocks for short sequences (one right-sized 128-multiple block),
-    # then pad ragged lengths to block multiples; pad *keys* are masked
-    # inside the kernel (valid_k), pad *query* rows compute garbage that is
-    # sliced off below (they still see ≥1 real key, so no 0/0).
-    block_q = min(block_q, _round_up(sq, 128))
-    block_k = min(block_k, _round_up(sk, 128))
-    sq_pad = _round_up(sq, block_q)
-    sk_pad = _round_up(sk, block_k)
+    # Pad ragged lengths only up to the 128-lane tile, then pick the largest
+    # block ≤ requested that divides the padded length — never pad to a full
+    # block multiple (at seq 787 that would waste ~30% of the rows). Pad
+    # *keys* are masked inside the kernel (valid_k); pad *query* rows
+    # compute garbage that is sliced off below (they still see ≥1 real key,
+    # so no 0/0).
+    sq_pad = _round_up(sq, 128)
+    sk_pad = _round_up(sk, 128)
+    block_q = _largest_dividing_block(block_q, sq_pad)
+    block_k = _largest_dividing_block(block_k, sk_pad)
     q, k, v = _pad_seq(q, sq_pad), _pad_seq(k, sk_pad), _pad_seq(v, sk_pad)
     # fold heads into the grid's batch dim: (B*H, S, D)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq_pad, d)
