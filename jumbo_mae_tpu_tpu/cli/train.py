@@ -104,7 +104,15 @@ def _strip_for_model(cfg: TrainConfig, batch: dict) -> dict:
     return {k: batch[k] for k in ("images", "labels") if k in batch}
 
 
-def make_train_iterator(cfg: TrainConfig, mesh, per_process: int):
+def make_train_iterator(cfg: TrainConfig, mesh, per_process: int, start_step: int = 0):
+    # Coarse data-cursor resume: restart the deterministic stream at the
+    # epoch the resumed step falls in (per-epoch shard order and shuffles
+    # are keyed on (seed, epoch), so no sample skipping is needed). One
+    # stream epoch yields dataset_size × repeats samples (repeated
+    # augmentation clones count toward the batch).
+    start_epoch = (start_step * cfg.run.train_batch_size) // max(
+        1, cfg.data.dataset_size * max(1, cfg.data.repeats)
+    )
     if cfg.run.synthetic_data:
         it = synthetic_batches(
             per_process,
@@ -120,6 +128,7 @@ def make_train_iterator(cfg: TrainConfig, mesh, per_process: int):
             per_process,
             process_index=jax.process_index(),
             process_count=jax.process_count(),
+            start_epoch=start_epoch,
         )
         it = (split_for_accum(b, cfg.run.grad_accum) for b in source)
     it = ({k: v for k, v in b.items() if k != "valid"} for b in it)
@@ -275,7 +284,7 @@ def train(cfg: TrainConfig) -> dict:
             evaluate(eval_step, state, valid_factory(), pad_batch),
         )
 
-    train_iter, source = make_train_iterator(cfg, mesh, per_process)
+    train_iter, source = make_train_iterator(cfg, mesh, per_process, start_step)
     meter = AverageMeter()
     timer = StepTimer(warmup_steps=min(2, max(1, run.training_steps - 1)))
     n_chips = len(jax.devices())

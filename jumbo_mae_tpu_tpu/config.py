@@ -120,7 +120,14 @@ def _resolve_epochs(doc: dict) -> dict:
     doc = {k: dict(v) if isinstance(v, dict) else v for k, v in doc.items()}
     run = doc.get("run", {})
     batch = run.get("train_batch_size", RunConfig.train_batch_size)
-    dataset = doc.pop("dataset_size", IMAGENET_TRAIN_SIZE)
+    # One source of truth for the dataset size: data.dataset_size wins, a
+    # top-level dataset_size is accepted as shorthand, then the ImageNet
+    # constant. The resolved value feeds BOTH the epochs→steps conversion
+    # and the resume data cursor (cli/train.py).
+    top_level = doc.pop("dataset_size", None)
+    data_sec = doc.setdefault("data", {})
+    dataset = data_sec.get("dataset_size", top_level) or IMAGENET_TRAIN_SIZE
+    data_sec["dataset_size"] = dataset
     if "epochs" in run:
         run["training_steps"] = steps_from_epochs(run.pop("epochs"), batch, dataset)
     optim = doc.get("optim", {})

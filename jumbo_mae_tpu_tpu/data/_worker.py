@@ -43,6 +43,7 @@ def _run(cfg_json: str) -> None:
         process_count=spec["process_count"],
         worker_index=spec["worker_index"],
         worker_count=spec["worker_count"],
+        start_epoch=spec.get("start_epoch", 0),
     )
     out = sys.stdout.buffer
     for batch in batch_train_samples(stream, spec["batch_size"], cfg.repeats):

@@ -65,6 +65,9 @@ class DataConfig:
     seed: int = 0
     workers: int = 4
     prefetch_batches: int = 4
+    # samples per epoch — used only to convert a resumed step count into the
+    # stream's starting epoch (coarse data-cursor resume)
+    dataset_size: int = 1_281_167
     # use the native C++ threaded tar reader (native/tario.cc) as the IO
     # substrate instead of per-worker Python tarfile streams
     use_native: bool = False
@@ -375,6 +378,7 @@ class TrainLoader:
         *,
         process_index: int = 0,
         process_count: int = 1,
+        start_epoch: int = 0,
     ):
         if batch_size % max(1, cfg.repeats):
             raise ValueError(
@@ -386,13 +390,19 @@ class TrainLoader:
         self._workers: list[_Worker] = []
         if cfg.use_native:
             stream = native_train_stream(
-                cfg, process_index=process_index, process_count=process_count
+                cfg,
+                process_index=process_index,
+                process_count=process_count,
+                start_epoch=start_epoch,
             )
             self._inline = batch_train_samples(stream, batch_size, cfg.repeats)
             return
         if cfg.workers <= 0:
             stream = train_sample_stream(
-                cfg, process_index=process_index, process_count=process_count
+                cfg,
+                process_index=process_index,
+                process_count=process_count,
+                start_epoch=start_epoch,
             )
             self._inline = batch_train_samples(stream, batch_size, cfg.repeats)
             return
@@ -408,6 +418,7 @@ class TrainLoader:
                 "process_count": process_count,
                 "worker_index": w,
                 "worker_count": cfg.workers,
+                "start_epoch": start_epoch,
             }
             self._workers.append(_Worker(spec, per_worker_q))
         self._next_worker = 0

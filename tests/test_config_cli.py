@@ -35,6 +35,25 @@ def test_epochs_resolution():
     assert cfg.optim.training_steps == cfg.run.training_steps
 
 
+def test_dataset_size_single_source_of_truth():
+    # data.dataset_size drives BOTH the epochs→steps math and the resume
+    # cursor; the top-level shorthand feeds data.dataset_size too.
+    cfg = config_from_dict(
+        {
+            "run": {"train_batch_size": 100, "epochs": 2},
+            "data": {"dataset_size": 1000},
+        }
+    )
+    assert cfg.run.training_steps == 1000 * 2 // 100
+    assert cfg.data.dataset_size == 1000
+
+    cfg2 = config_from_dict(
+        {"dataset_size": 500, "run": {"train_batch_size": 100, "epochs": 2}}
+    )
+    assert cfg2.run.training_steps == 500 * 2 // 100
+    assert cfg2.data.dataset_size == 500
+
+
 def test_overrides_dotted_paths():
     doc = apply_overrides({}, ["optim.learning_rate=1e-3", "run.mode=finetune"])
     cfg = config_from_dict(doc)
