@@ -1,0 +1,182 @@
+"""Input-pipeline throughput benchmark (SURVEY §7 stage 4: "validate
+throughput ≥ reference's torch pipeline").
+
+Builds synthetic JPEG webdataset shards, then measures steady-state
+imgs/sec for the same decode+augment work under each loader substrate:
+
+- ``inline``  — single-stream Python reader (workers=0);
+- ``workers`` — this framework's fresh-interpreter worker subprocesses;
+- ``native``  — the C++ threaded tar reader (native/tario.cc) + thread-pool
+  decode (GIL-releasing cv2/PIL);
+- ``torch``   — the SAME sample stream wrapped in ``torch.utils.data
+  .DataLoader`` with worker processes, i.e. the reference's loader machinery
+  (``/root/reference/src/dataset.py:124-161``) with identical per-sample
+  work (torchvision/timm aren't installed here; augmentation parity is
+  tested separately in tests/test_transforms.py).
+
+Usage: python tools/bench_data.py [--images 512] [--batches 20] [--batch 32]
+Prints one JSON line per mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from jumbo_mae_tpu_tpu.data.loader import (  # noqa: E402
+    DataConfig,
+    TrainLoader,
+    batch_train_samples,
+    train_sample_stream,
+)
+from jumbo_mae_tpu_tpu.data.native import available as native_available  # noqa: E402
+from jumbo_mae_tpu_tpu.data.tario import write_tar_samples  # noqa: E402
+
+
+def build_shards(root: Path, *, shards: int, per_shard: int, size: int) -> str:
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for s in range(shards):
+        samples = []
+        for i in range(per_shard):
+            arr = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, "JPEG", quality=90)
+            samples.append(
+                {
+                    "__key__": f"{s:04d}_{i:05d}",
+                    "jpg": buf.getvalue(),
+                    "cls": str(i % 1000).encode(),
+                }
+            )
+        write_tar_samples(str(root / f"bench-{s:04d}.tar"), samples)
+    return str(root / ("bench-{0000..%04d}.tar" % (shards - 1)))
+
+
+def drain(it, *, batches: int, warmup: int, batch_size: int) -> float:
+    for _ in range(warmup):
+        next(it)
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        next(it)
+    return batches * batch_size / (time.perf_counter() - t0)
+
+
+def bench_torch(
+    cfg: DataConfig, batch_size: int, *, batches: int, warmup: int, workers: int
+):
+    from torch.utils import data as tdata
+
+    class Stream(tdata.IterableDataset):
+        def __iter__(self):
+            info = tdata.get_worker_info()
+            w, nw = (info.id, info.num_workers) if info else (0, 1)
+            return train_sample_stream(cfg, worker_index=w, worker_count=nw)
+
+    loader = tdata.DataLoader(
+        Stream(),
+        batch_size=batch_size,
+        num_workers=workers,
+        prefetch_factor=2,
+        drop_last=True,
+        collate_fn=lambda items: {
+            "images": np.stack([i for i, _ in items]),
+            "labels": np.array([l for _, l in items]),
+        },
+    )
+    it = iter(loader)
+    try:
+        return drain(it, batches=batches, warmup=warmup, batch_size=batch_size)
+    finally:
+        del it, loader
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=512, help="total synthetic images")
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--batches", type=int, default=15)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--keep-dir", default=None, help="reuse/keep shard dir")
+    args = ap.parse_args()
+
+    root = Path(args.keep_dir) if args.keep_dir else Path(tempfile.mkdtemp(prefix="benchdata_"))
+    root.mkdir(parents=True, exist_ok=True)
+    shards = 4
+    if args.images < shards:
+        ap.error(f"--images must be ≥ {shards} (one sample per shard minimum)")
+    spec = build_shards(
+        root, shards=shards, per_shard=args.images // shards, size=args.size
+    )
+
+    base = dict(
+        train_shards=spec,
+        image_size=args.size,
+        crop_mode="rrc",
+        auto_augment="rand-m9-n2",
+        shuffle_buffer=64,
+        seed=0,
+    )
+    results = {}
+
+    cfg = DataConfig(**base, workers=0)
+    it = iter(TrainLoader(cfg, args.batch))
+    results["inline"] = drain(
+        it, batches=args.batches, warmup=args.warmup, batch_size=args.batch
+    )
+
+    cfg = DataConfig(**base, workers=args.workers)
+    loader = TrainLoader(cfg, args.batch)
+    results["workers"] = drain(
+        iter(loader), batches=args.batches, warmup=args.warmup, batch_size=args.batch
+    )
+    loader.close()
+
+    if native_available():
+        cfg = DataConfig(**base, use_native=True, decode_threads=args.workers)
+        it = iter(TrainLoader(cfg, args.batch))
+        results["native"] = drain(
+            it, batches=args.batches, warmup=args.warmup, batch_size=args.batch
+        )
+
+    try:
+        cfg = DataConfig(**base, workers=0)
+        results["torch"] = bench_torch(
+            cfg,
+            args.batch,
+            batches=args.batches,
+            warmup=args.warmup,
+            workers=args.workers,
+        )
+    except Exception as e:  # noqa: BLE001 — torch optional
+        results["torch_error"] = str(e)
+
+    for mode, rate in results.items():
+        print(
+            json.dumps(
+                {
+                    "metric": f"data_pipeline_{mode}_imgs_per_sec",
+                    "value": round(rate, 1) if isinstance(rate, float) else rate,
+                    "unit": "imgs/sec",
+                }
+            )
+        )
+    if not args.keep_dir:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
