@@ -159,6 +159,36 @@ class TestPretrainStep:
                     float(m_ring["loss"]), want, rtol=1e-4
                 )
 
+    def test_all_axes_composed_matches_single_device(self):
+        # fsdp=2 × tensor=2 × seq=2 on one mesh, ring attention active —
+        # every implemented parallelism at once must still equal the
+        # single-device step.
+        batch = batch_of(16)
+        _, s1, _, step1 = build(
+            MeshConfig(data=1, fsdp=1), pretrain_module(), "pretrain", batch=batch
+        )
+        s1, m1 = step1(s1, batch)
+        want = float(m1["loss"])
+
+        module = MAEPretrainModel(
+            TINY.replace(mask_ratio=0.75, labels=None, attn_impl="ring"),
+            TINY_DEC.replace(attn_impl="ring"),
+        )
+        mesh = create_mesh(MeshConfig(data=1, fsdp=2, tensor=2, seq=2))
+        tx = make_optimizer(OPT, global_batch_size=256)
+        with jax.sharding.set_mesh(mesh):
+            st, sharding = create_sharded_state(
+                module, tx, batch, mesh, mode="pretrain", init_seed=0,
+                rng_seed=0, min_shard_size=128,
+            )
+            specs = str(jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(lambda s: s.spec, sharding.params)
+            ))
+            assert "tensor" in specs and "fsdp" in specs, specs
+            step = make_train_step(mesh, sharding, mode="pretrain")
+            st, m = step(st, batch)
+        np.testing.assert_allclose(float(m["loss"]), want, rtol=1e-4)
+
     def test_learning_rate_logged(self):
         batch = batch_of(8)
         _, state, _, step = build(

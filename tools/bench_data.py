@@ -88,7 +88,7 @@ def bench_torch(
         Stream(),
         batch_size=batch_size,
         num_workers=workers,
-        prefetch_factor=2,
+        prefetch_factor=2 if workers else None,
         drop_last=True,
         collate_fn=lambda items: {
             "images": np.stack([i for i, _ in items]),
@@ -118,9 +118,15 @@ def main():
     shards = 4
     if args.images < shards:
         ap.error(f"--images must be ≥ {shards} (one sample per shard minimum)")
-    spec = build_shards(
-        root, shards=shards, per_shard=args.images // shards, size=args.size
-    )
+    if args.workers < 1:
+        ap.error("--workers must be ≥ 1 (the point is comparing worker machinery)")
+    spec = str(root / ("bench-{0000..%04d}.tar" % (shards - 1)))
+    if not all(
+        (root / f"bench-{s:04d}.tar").exists() for s in range(shards)
+    ):
+        spec = build_shards(
+            root, shards=shards, per_shard=args.images // shards, size=args.size
+        )
 
     base = dict(
         train_shards=spec,
@@ -162,14 +168,14 @@ def main():
             workers=args.workers,
         )
     except Exception as e:  # noqa: BLE001 — torch optional
-        results["torch_error"] = str(e)
+        print(json.dumps({"error": f"torch comparison skipped: {e}"}))
 
     for mode, rate in results.items():
         print(
             json.dumps(
                 {
                     "metric": f"data_pipeline_{mode}_imgs_per_sec",
-                    "value": round(rate, 1) if isinstance(rate, float) else rate,
+                    "value": round(rate, 1),
                     "unit": "imgs/sec",
                 }
             )
