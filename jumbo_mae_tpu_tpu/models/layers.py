@@ -58,26 +58,22 @@ class Attention(nn.Module):
         # The flash/ring paths have no attention-probability dropout; any
         # dropout>0 must take the einsum path so training semantics don't
         # silently change.
-        use_flash = cfg.dropout == 0.0 and (
-            cfg.attn_impl == "flash"
-            or (
-                cfg.attn_impl == "auto"
-                and jax.default_backend() == "tpu"
-                # ≥128 tokens: the fused kernel avoids materializing the
-                # (B,H,S,S) float32 score tensor. At the MAE decoder's S=199
-                # that's a measured speed wash but an O(S²)→O(S) memory win
-                # (PERF.md §decisions); below 128 the padding waste makes
-                # einsum strictly better.
-                and q.shape[1] >= 128
-            )
-        )
-        if cfg.attn_impl == "ring" and cfg.dropout > 0.0:
-            # Unlike "auto"→flash (a speed choice), "ring" is an explicit
-            # parallelism request; silently degrading to O(S²) per-device
-            # attention would defeat it — fail loudly instead.
+        #
+        # "auto" resolves to the einsum path: XLA's fused attention measured
+        # fastest at EVERY tested MAE shape on v5e — seq 199 (wash), 787
+        # (flash 37% slower), 3139 (flash 77% slower; einsum+remat still
+        # fits) — because the Pallas forward pairs with a slower blockwise
+        # backward (PERF.md §decisions). "flash" stays an explicit opt-in
+        # for memory regimes where the score tensor cannot exist at all.
+        use_flash = cfg.attn_impl == "flash"
+        if cfg.attn_impl in ("flash", "ring") and cfg.dropout > 0.0:
+            # Both are explicit requests — "ring" for sequence parallelism,
+            # "flash" for O(S) score memory; silently degrading either to
+            # the O(S²) einsum path would defeat the reason it was chosen.
             raise ValueError(
-                "attn_impl='ring' has no attention-probability dropout; "
-                "set dropout=0.0 (droppath regularization still applies)"
+                f"attn_impl={cfg.attn_impl!r} has no attention-probability "
+                "dropout; set dropout=0.0 (droppath regularization still "
+                "applies)"
             )
         if cfg.attn_impl == "ring":
             # Sequence parallelism: tokens shard over the ambient mesh's

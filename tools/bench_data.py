@@ -43,8 +43,23 @@ from jumbo_mae_tpu_tpu.data.native import available as native_available  # noqa:
 from jumbo_mae_tpu_tpu.data.tario import write_tar_samples  # noqa: E402
 
 
+def shard_spec(root: Path, shards: int) -> str:
+    return str(root / ("bench-{0000..%04d}.tar" % (shards - 1)))
+
+
 def build_shards(root: Path, *, shards: int, per_shard: int, size: int) -> str:
+    """Build the synthetic shard set, reusing an existing one only when it
+    was built with identical parameters (recorded in a stamp file)."""
     from PIL import Image
+
+    stamp = root / "bench-params.json"
+    params = {"shards": shards, "per_shard": per_shard, "size": size}
+    if (
+        stamp.exists()
+        and json.loads(stamp.read_text()) == params
+        and all((root / f"bench-{s:04d}.tar").exists() for s in range(shards))
+    ):
+        return shard_spec(root, shards)
 
     rng = np.random.default_rng(0)
     for s in range(shards):
@@ -61,7 +76,8 @@ def build_shards(root: Path, *, shards: int, per_shard: int, size: int) -> str:
                 }
             )
         write_tar_samples(str(root / f"bench-{s:04d}.tar"), samples)
-    return str(root / ("bench-{0000..%04d}.tar" % (shards - 1)))
+    stamp.write_text(json.dumps(params))
+    return shard_spec(root, shards)
 
 
 def drain(it, *, batches: int, warmup: int, batch_size: int) -> float:
@@ -118,15 +134,9 @@ def main():
     shards = 4
     if args.images < shards:
         ap.error(f"--images must be ≥ {shards} (one sample per shard minimum)")
-    if args.workers < 1:
-        ap.error("--workers must be ≥ 1 (the point is comparing worker machinery)")
-    spec = str(root / ("bench-{0000..%04d}.tar" % (shards - 1)))
-    if not all(
-        (root / f"bench-{s:04d}.tar").exists() for s in range(shards)
-    ):
-        spec = build_shards(
-            root, shards=shards, per_shard=args.images // shards, size=args.size
-        )
+    spec = build_shards(
+        root, shards=shards, per_shard=args.images // shards, size=args.size
+    )
 
     base = dict(
         train_shards=spec,
@@ -144,12 +154,13 @@ def main():
         it, batches=args.batches, warmup=args.warmup, batch_size=args.batch
     )
 
-    cfg = DataConfig(**base, workers=args.workers)
-    loader = TrainLoader(cfg, args.batch)
-    results["workers"] = drain(
-        iter(loader), batches=args.batches, warmup=args.warmup, batch_size=args.batch
-    )
-    loader.close()
+    if args.workers > 0:  # workers=0 would just re-measure the inline mode
+        cfg = DataConfig(**base, workers=args.workers)
+        loader = TrainLoader(cfg, args.batch)
+        results["workers"] = drain(
+            iter(loader), batches=args.batches, warmup=args.warmup, batch_size=args.batch
+        )
+        loader.close()
 
     if native_available():
         cfg = DataConfig(**base, use_native=True, decode_threads=args.workers)
