@@ -1,19 +1,32 @@
-"""Pallas TPU flash-attention forward kernel.
+"""Pallas TPU flash-attention kernels — forward AND backward.
 
-Grid: (batch·heads, seq_q/block_q). Each program holds one query block in
-VMEM and streams the full key/value sequence for its batch-head through a
-``fori_loop`` of ``block_k`` chunks with the online-softmax recurrence —
-the (seq, seq) score matrix never exists in HBM, scores are accumulated on
-the MXU in float32.
+Forward: grid (batch·heads, seq_q/block_q). Each program holds one query
+block in VMEM and streams the full key/value sequence for its batch-head
+through a ``fori_loop`` of ``block_k`` chunks with the online-softmax
+recurrence — the (seq, seq) score matrix never exists in HBM, scores are
+accumulated on the MXU in float32. The per-row logsumexp is written as a
+second output and saved for the backward.
 
-The backward pass is delegated to the differentiable XLA blockwise
-implementation (``ops/blockwise_attention.py``) via ``jax.custom_vjp``:
-residuals are just (q, k, v), recomputed chunkwise — O(seq) memory both ways.
+Backward (FlashAttention-style, two kernels so no cross-program
+accumulation is needed):
+
+- ``_bwd_dq_kernel``   — grid over q blocks; recomputes P = exp(qkᵀ − lse)
+  per k chunk and accumulates dQ = Σ (P ∘ (dO·Vᵀ − D))·K;
+- ``_bwd_dkv_kernel``  — grid over k blocks; loops over q chunks and
+  accumulates dV = Σ Pᵀ·dO and dK = Σ (P ∘ (dO·Vᵀ − D))ᵀ·Q,
+
+where D = rowsum(dO ∘ O) is precomputed outside the kernels. Memory stays
+O(seq) end to end — the residuals are just (q, k, v, o, lse).
+
+Ragged sequence lengths are first-class: inputs pad to the 128-lane tile
+and pad *keys* are masked to −inf wherever scores are (re)computed. Pad
+*query* rows need no masking anywhere: their forward output is sliced off,
+so their incoming dO is zero and every backward contribution vanishes.
 
 Heads are folded into the batch/grid dimension, so per-program tiles are 2-D
 (block, head_dim) — aligned with the (8/16, 128) sublane×lane tiling as long
-as head_dim is a multiple of 128 (true for every preset: 64-dim heads are
-padded by Mosaic automatically, at some efficiency cost).
+as head_dim is a multiple of 128 (64/32-dim heads are padded by Mosaic
+automatically, at some efficiency cost).
 """
 
 from __future__ import annotations
@@ -25,9 +38,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+LANE = 128  # minor-dim tile floor for per-row scalars (lse, D)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, valid_k: int):
+def _mask_cols(s, col0: int, valid_k: int):
+    """Set score columns at global key index ≥ valid_k to −inf."""
+    rows, cols = s.shape
+    col = col0 + jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1)
+    return jnp.where(col < valid_k, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, valid_k: int):
     q = q_ref[0].astype(jnp.float32)  # (block_q, d)
     block_q, d = q.shape
     seq_k = k_ref.shape[1]
@@ -40,12 +61,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, valid_k: int):
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (block_q, block_k)
         if valid_k != seq_k:
-            # keys beyond valid_k are zero-padding (ragged seq support):
-            # force their scores to -inf so they get zero softmax weight.
-            col = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(col < valid_k, s, NEG_INF)
+            s = _mask_cols(s, i * block_k, valid_k)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -58,15 +74,92 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, valid_k: int):
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, seq_k // block_k, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, seq_k // block_k, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    if lse_ref is not None:
+        # per-row scalar broadcast over a 128-lane minor dim (Mosaic's
+        # tiling floor for the last two block dims)
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, LANE))
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *, block_k: int, valid_k: int
+):
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, :1]  # (block_q, 1) — scalar replicated over lanes
+    dd = dd_ref[0][:, :1]
+    block_q, d = q.shape
+    seq_k = k_ref.shape[1]
+
+    def body(i, dq):
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if valid_k != seq_k:
+            s = _mask_cols(s, i * block_k, valid_k)
+        p = jnp.exp(s - lse)  # (block_q, block_k)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dd)
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(
+        0, seq_k // block_k, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref, dv_ref,
+    *, block_q: int, valid_k: int, masked: bool,
+):
+    k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+    block_k, d = k.shape
+    seq_q = q_ref.shape[1]
+    col0 = pl.program_id(1) * block_k
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :1]
+        dd = dd_ref[0, pl.ds(i * block_q, block_q), :1]
+        s = jax.lax.dot_general(
+            qb, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        if masked:
+            s = _mask_cols(s, col0, valid_k)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            dob, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dd)
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, seq_q // block_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _pad_seq(x, to: int):
     pad = to - x.shape[1]
     if not pad:
         return x
-    return jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return jnp.pad(x, ((0, 0), (0, pad)) + ((0, 0),) * (x.ndim - 2))
 
 
 def _round_up(x: int, to: int) -> int:
@@ -88,26 +181,46 @@ def _largest_dividing_block(requested: int, seq_pad: int) -> int:
     return block
 
 
-def _flash_fwd(q, k, v, block_q, block_k, interpret):
+def _fold(x, b, h, s, d):
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, h, s, d):
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _plan(q, k, block_q, block_k):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     # Pad ragged lengths only up to the 128-lane tile, then pick the largest
     # block ≤ requested that divides the padded length — never pad to a full
-    # block multiple (at seq 787 that would waste ~30% of the rows). Pad
-    # *keys* are masked inside the kernel (valid_k); pad *query* rows
-    # compute garbage that is sliced off below (they still see ≥1 real key,
-    # so no 0/0).
+    # block multiple (at seq 787 that would waste ~30% of the rows).
     sq_pad = _round_up(sq, 128)
     sk_pad = _round_up(sk, 128)
-    block_q = _largest_dividing_block(block_q, sq_pad)
-    block_k = _largest_dividing_block(block_k, sk_pad)
-    q, k, v = _pad_seq(q, sq_pad), _pad_seq(k, sk_pad), _pad_seq(v, sk_pad)
-    # fold heads into the grid's batch dim: (B*H, S, D)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq_pad, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk_pad, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk_pad, d)
+    return (
+        b, sq, h, d, sk, sq_pad, sk_pad,
+        _largest_dividing_block(block_q, sq_pad),
+        _largest_dividing_block(block_k, sk_pad),
+    )
 
-    out = pl.pallas_call(
+
+def _flash_fwd(q, k, v, block_q, block_k, interpret, with_lse: bool):
+    b, sq, h, d, sk, sq_pad, sk_pad, block_q, block_k = _plan(q, k, block_q, block_k)
+    qf = _fold(_pad_seq(q, sq_pad), b, h, sq_pad, d)
+    kf = _fold(_pad_seq(k, sk_pad), b, h, sk_pad, d)
+    vf = _fold(_pad_seq(v, sk_pad), b, h, sk_pad, d)
+
+    o_spec = pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0))
+    o_shape = jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype)
+    if with_lse:
+        # the lse output rides a 128-lane minor dim inside the kernel
+        # (Mosaic tiling floor); only the first lane is kept as residual
+        out_specs = [o_spec, pl.BlockSpec((1, block_q, LANE), lambda bh, i: (bh, i, 0))]
+        out_shape = [o_shape, jax.ShapeDtypeStruct((b * h, sq_pad, LANE), jnp.float32)]
+    else:
+        out_specs, out_shape = o_spec, o_shape
+
+    res = pl.pallas_call(
         functools.partial(_fwd_kernel, block_k=block_k, valid_k=sk),
         grid=(b * h, sq_pad // block_q),
         in_specs=[
@@ -115,12 +228,75 @@ def _flash_fwd(q, k, v, block_q, block_k, interpret):
             pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
         ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(qf, kf, vf)
+    out, lse = res if with_lse else (res, None)
+    out = _unfold(out, b, h, sq_pad, d)
+    out = out[:, :sq] if sq_pad != sq else out
+    return (out, lse[..., 0]) if with_lse else (out, None)
+
+
+def _flash_bwd(q, k, v, o, lse, g, block_q, block_k, interpret):
+    b, sq, h, d, sk, sq_pad, sk_pad, block_q, block_k = _plan(q, k, block_q, block_k)
+    qf = _fold(_pad_seq(q, sq_pad), b, h, sq_pad, d)
+    kf = _fold(_pad_seq(k, sk_pad), b, h, sk_pad, d)
+    vf = _fold(_pad_seq(v, sk_pad), b, h, sk_pad, d)
+    dof = _fold(_pad_seq(g, sq_pad), b, h, sq_pad, d)
+    of = _fold(_pad_seq(o, sq_pad), b, h, sq_pad, d)
+    # D = rowsum(dO ∘ O): tiny and elementwise — jnp, not a kernel. Pad q
+    # rows have dO = 0 ⇒ D = 0 ⇒ all their backward contributions vanish.
+    # Both per-row scalars are replicated over the lane dim only here, at
+    # kernel entry (the lse residual is stored compact, (b*h, sq_pad)).
+    dd = (dof.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1)
+    dd = jnp.broadcast_to(dd[..., None], (b * h, sq_pad, LANE))
+    lse = jnp.broadcast_to(lse[..., None], (b * h, sq_pad, LANE))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, valid_k=sk),
+        grid=(b * h, sq_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, LANE), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, LANE), lambda bh, i: (bh, i, 0)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq_pad, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    out = out.reshape(b, h, sq_pad, d).transpose(0, 2, 1, 3)
-    return out[:, :sq] if sq_pad != sq else out
+    )(qf, kf, vf, dof, lse, dd)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=block_q, valid_k=sk, masked=sk != sk_pad
+        ),
+        grid=(b * h, sk_pad // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq_pad, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, sq_pad, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_pad, LANE), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, sq_pad, LANE), lambda bh, j: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk_pad, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, dd)
+
+    dq = _unfold(dq, b, h, sq_pad, d)[:, :sq]
+    dk = _unfold(dk, b, h, sk_pad, d)[:, :sk]
+    dv = _unfold(dv, b, h, sk_pad, d)[:, :sk]
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -134,26 +310,24 @@ def pallas_flash_attention(
 ) -> jax.Array:
     """Flash attention over (batch, seq, heads, head_dim); q pre-scaled.
 
-    Arbitrary sequence lengths: inputs are padded to block multiples and the
-    pad keys are masked to -inf inside the kernel (MAE shapes like 199 are
-    first-class). ``interpret=True`` runs the kernel in the Pallas
-    interpreter (CPU tests).
+    Arbitrary sequence lengths: inputs are padded to lane tiles and the pad
+    keys are masked to -inf inside the kernels (MAE shapes like 199 are
+    first-class). Forward and backward are both Pallas kernels with O(seq)
+    memory. ``interpret=True`` runs them in the Pallas interpreter (CPU
+    tests).
     """
-    return _flash_fwd(q, k, v, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, block_q, block_k, interpret, with_lse=False)
+    return out
 
 
 def _vjp_fwd(q, k, v, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_fwd(q, k, v, block_q, block_k, interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(block_q, block_k, interpret, residuals, g):
-    from jumbo_mae_tpu_tpu.ops.blockwise_attention import blockwise_attention
-
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        functools.partial(blockwise_attention, block_k=block_k), q, k, v
-    )
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    return _flash_bwd(q, k, v, o, lse, g, block_q, block_k, interpret)
 
 
 pallas_flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

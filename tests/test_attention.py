@@ -102,7 +102,7 @@ class TestPallasKernel:
         for a, b in zip(g, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
-    def test_backward_via_blockwise(self):
+    def test_backward_kernel_matches_naive(self):
         q, k, v = qkv(s=128, d=128)
 
         def loss(q, k, v):
