@@ -20,9 +20,8 @@ import numpy as np
 
 
 MODELS = {
-    # preset, decoder, default batch, remat
-    "vit_l16": ("vit_l16", dict(layers=8, dim=512, heads=16), 128, False),
-    "vit_h14": ("vit_h14", dict(layers=8, dim=512, heads=16), 32, True),
+    "vit_l16": dict(dec=dict(layers=8, dim=512, heads=16), batch=128, remat=False),
+    "vit_h14": dict(dec=dict(layers=8, dim=512, heads=16), batch=32, remat=True),
 }
 
 
@@ -42,19 +41,19 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
         make_train_step,
     )
 
-    model_name, dec_kw, _, remat = MODELS[model]
+    spec = MODELS[model]
     mesh = create_mesh(
         MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1]
     )
     enc = preset(
-        model_name,
+        model,
         mask_ratio=0.75,
         labels=None,
         posemb="sincos2d",
         dtype=dtype,
-        grad_ckpt=remat,
+        grad_ckpt=spec["remat"],
     )
-    dec = DecoderConfig(**dec_kw, dtype=dtype)
+    dec = DecoderConfig(**spec["dec"], dtype=dtype)
     module = MAEPretrainModel(enc, dec, norm_pix_loss=True)
 
     batch = {
@@ -103,7 +102,7 @@ def main():
         raise SystemExit(
             f"unknown BENCH_MODEL {model!r}; choose from {sorted(MODELS)}"
         )
-    batch_size = int(os.environ.get("BENCH_BATCH", str(MODELS[model][2])))
+    batch_size = int(os.environ.get("BENCH_BATCH", str(MODELS[model]["batch"])))
     iters = int(os.environ.get("BENCH_ITERS", "10"))
 
     step, state, batch = build_step("bfloat16", batch_size, model)
@@ -111,13 +110,11 @@ def main():
     imgs_per_sec = batch_size / dt
     del step, state
 
-    baseline_env = os.environ.get("BENCH_SKIP_BASELINE")
-    if baseline_env:
-        ratio = float("nan")
-    else:
+    ratio = None
+    if not os.environ.get("BENCH_SKIP_BASELINE"):
         step_f32, state_f32, batch = build_step("float32", batch_size, model)
         dt_f32 = time_steps(step_f32, state_f32, batch, warmup=2, iters=max(4, iters // 2))
-        ratio = (batch_size / dt_f32) and imgs_per_sec / (batch_size / dt_f32)
+        ratio = round(dt_f32 / dt, 3)
 
     print(
         json.dumps(
@@ -125,7 +122,7 @@ def main():
                 "metric": f"mae_{model}_224_pretrain_imgs_per_sec_per_chip",
                 "value": round(imgs_per_sec, 2),
                 "unit": "imgs/sec/chip",
-                "vs_baseline": round(ratio, 3) if ratio == ratio else None,
+                "vs_baseline": ratio,
             }
         )
     )

@@ -65,7 +65,6 @@ class Attention(nn.Module):
         # fits) — because the Pallas forward pairs with a slower blockwise
         # backward (PERF.md §decisions). "flash" stays an explicit opt-in
         # for memory regimes where the score tensor cannot exist at all.
-        use_flash = cfg.attn_impl == "flash"
         if cfg.attn_impl in ("flash", "ring") and cfg.dropout > 0.0:
             # Both are explicit requests — "ring" for sequence parallelism,
             # "flash" for O(S) score memory; silently degrading either to
@@ -83,7 +82,7 @@ class Attention(nn.Module):
             )
 
             z = ring_self_attention(q, k, v)
-        elif use_flash:
+        elif cfg.attn_impl == "flash":
             from jumbo_mae_tpu_tpu.ops.flash_attention import flash_attention
 
             z = flash_attention(q, k, v)

@@ -99,19 +99,12 @@ def ring_attention_sharded(
     seq_axis: str = "seq",
     batch_axes=("data", "fsdp"),
 ) -> jax.Array:
-    """shard_map wrapper: global (B, S, H, D) inputs with S sharded over
-    ``seq_axis`` (and batch over ``batch_axes``); emits the identically
-    sharded attention output. S must divide evenly — use
-    :func:`ring_self_attention` for arbitrary lengths."""
-    spec = P(tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None, seq_axis)
-    fn = jax.shard_map(
-        partial(ring_attention, axis_name=seq_axis),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
+    """Explicit-mesh alias of :func:`ring_self_attention`: global
+    (B, S, H, D) inputs with S sharded over ``seq_axis`` (and batch over
+    ``batch_axes``); emits the identically sharded attention output."""
+    return ring_self_attention(
+        q, k, v, seq_axis=seq_axis, batch_axes=batch_axes, mesh=mesh
     )
-    return fn(q, k, v)
 
 
 def ring_self_attention(
@@ -121,18 +114,20 @@ def ring_self_attention(
     *,
     seq_axis: str = "seq",
     batch_axes=("data", "fsdp"),
+    mesh: Mesh | None = None,
 ) -> jax.Array:
-    """Sequence-parallel self-attention over the *ambient* mesh, for use
-    inside model code under ``jit`` (activate the mesh with
-    ``jax.sharding.set_mesh``). Handles sequence lengths that don't divide
-    the ``seq`` axis by zero-padding K/V and masking the pad keys (the mask
-    ring-rotates with its block). Falls back to plain attention when no
-    ambient mesh is active or its ``seq`` axis is trivial.
+    """Sequence-parallel self-attention, for use inside model code under
+    ``jit``. Uses the *ambient* mesh by default (activate with
+    ``jax.sharding.set_mesh``) or an explicitly passed ``mesh``. Handles
+    sequence lengths that don't divide the ``seq`` axis by zero-padding K/V
+    and masking the pad keys (the mask ring-rotates with its block). Falls
+    back to plain attention when no mesh is active or its ``seq`` axis is
+    trivial.
 
     q, k, v: (batch, seq, heads, head_dim), queries pre-scaled.
     """
-    mesh = jax.sharding.get_abstract_mesh()
-    n = mesh.shape.get(seq_axis, 1) if mesh is not None else 1
+    shape = (mesh or jax.sharding.get_abstract_mesh()).shape
+    n = shape.get(seq_axis, 1)
     if not n or n <= 1:
         from jumbo_mae_tpu_tpu.ops.flash_attention import xla_attention
 
@@ -141,21 +136,22 @@ def ring_self_attention(
     b, s, h, d = q.shape
     s_pad = -(-s // n) * n
     pad = s_pad - s
-    bspec = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1) or None
+    bspec = tuple(a for a in batch_axes if shape.get(a, 1) > 1) or None
     qkv_spec = P(bspec, seq_axis, None, None)
     if not pad:
-        out = jax.shard_map(
+        return jax.shard_map(
             partial(ring_attention, axis_name=seq_axis),
+            mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec),
             out_specs=qkv_spec,
             check_vma=False,
         )(q, k, v)
-        return out
     widths = ((0, 0), (0, pad), (0, 0), (0, 0))
     q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
     kv_mask = jnp.broadcast_to(jnp.arange(s_pad) < s, (b, s_pad))
     out = jax.shard_map(
         partial(ring_attention, axis_name=seq_axis),
+        mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, P(bspec, seq_axis)),
         out_specs=qkv_spec,
         check_vma=False,
