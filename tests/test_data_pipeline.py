@@ -178,6 +178,45 @@ def test_train_loader_start_epoch_resume(shard_dir):
     assert not np.array_equal(got["images"], head0["images"])
 
 
+def test_prepare_dataset_tool_roundtrip(tmp_path):
+    """tools/prepare_dataset.py: image folder → shards our loaders stream."""
+    import json
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "src" / cls
+        d.mkdir(parents=True)
+        for i in range(6):
+            arr = rng.integers(0, 255, (40, 40, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.jpg")
+
+    out = tmp_path / "shards"
+    proc = subprocess.run(
+        [
+            _sys.executable, "tools/prepare_dataset.py",
+            "--src", str(tmp_path / "src"), "--out", str(out),
+            "--prefix", "train", "--shard-size", "5",
+        ],
+        capture_output=True, text=True, check=True,
+        cwd=str(Path(__file__).resolve().parent.parent),
+    )
+    info = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert info["samples"] == 12 and info["classes"] == 2 and info["shards"] == 3
+    assert json.loads((out / "classes.json").read_text()) == ["cat", "dog"]
+
+    cfg = DataConfig(
+        train_shards=info["spec"], image_size=32, workers=0, shuffle_buffer=0
+    )
+    batch = next(TrainLoader(cfg, batch_size=8))
+    assert batch["images"].shape == (8, 32, 32, 3)
+    assert set(batch["labels"].tolist()) <= {0, 1}
+
+
 def test_valid_loader_pad_contract(shard_dir):
     cfg = _cfg(shard_dir)
     batches = list(valid_loader(cfg, batch_size=5))

@@ -1,0 +1,106 @@
+"""Build webdataset tar shards from an image-folder dataset.
+
+Counterpart of the reference's dataset prep
+(``/root/reference/scripts/prepare-imagenet1k-dataset.sh``), which downloaded
+ready-made ImageNet shards; this tool builds the same shard format from any
+local ``class_name/image.jpg`` directory tree, so the framework's loaders
+(``data/loader.py``) can stream it.
+
+Layout expected:  root/<class_dir>/<image>.{jpg,jpeg,png}
+Shard layout:     {out}/{prefix}-{idx:06d}.tar with members
+                  ``<key>.jpg`` + ``<key>.cls`` (integer class index, by
+                  sorted class-dir order — written to {out}/classes.json).
+
+Usage:
+    python tools/prepare_dataset.py --src /data/train --out /data/shards \
+        --prefix train --shard-size 1000 [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from jumbo_mae_tpu_tpu.data.tario import write_tar_samples  # noqa: E402
+
+IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".webp", ".bmp"}
+
+
+def collect(src: Path) -> tuple[list[tuple[Path, int]], list[str]]:
+    classes = sorted(p.name for p in src.iterdir() if p.is_dir())
+    class_to_idx = {c: i for i, c in enumerate(classes)}
+    files = [
+        (f, class_to_idx[c])
+        for c in classes
+        for f in sorted((src / c).iterdir())
+        if f.suffix.lower() in IMAGE_EXTS
+    ]
+    return files, classes
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", required=True, help="image-folder root (class dirs)")
+    ap.add_argument("--out", required=True, help="output shard directory")
+    ap.add_argument("--prefix", default="train")
+    ap.add_argument("--shard-size", type=int, default=1000, help="samples per shard")
+    ap.add_argument(
+        "--seed", type=int, default=0,
+        help="shuffle seed (shards should mix classes; <0 keeps sorted order)",
+    )
+    args = ap.parse_args()
+    if args.shard_size <= 0:
+        ap.error("--shard-size must be positive")
+
+    src, out = Path(args.src), Path(args.out)
+    files, classes = collect(src)
+    if not files:
+        raise SystemExit(f"no images found under {src}")
+    if args.seed >= 0:
+        random.Random(args.seed).shuffle(files)
+    out.mkdir(parents=True, exist_ok=True)
+    (out / "classes.json").write_text(json.dumps(classes, indent=0))
+
+    n_shards = -(-len(files) // args.shard_size)
+    width = max(6, len(str(n_shards - 1)))
+    for s in range(n_shards):
+        chunk = files[s * args.shard_size : (s + 1) * args.shard_size]
+        samples = [
+            # key must be dot-free (tario splits members at the first dot of
+            # the basename) and unique (same-stem .jpg/.png files would
+            # otherwise merge into one sample) — sanitize and append a
+            # global running index. decode_image sniffs the payload bytes,
+            # so the member is always named "jpg" regardless of source
+            # format.
+            {
+                "__key__": (
+                    f"{path.parent.name}_{path.stem}".replace(".", "_")
+                    + f"_{s * args.shard_size + j:07d}"
+                ),
+                "jpg": path.read_bytes(),
+                "cls": str(label).encode(),
+            }
+            for j, (path, label) in enumerate(chunk)
+        ]
+        write_tar_samples(str(out / f"{args.prefix}-{s:0{width}d}.tar"), samples)
+
+    spec = f"{out}/{args.prefix}-{{{'0' * width}..{n_shards - 1:0{width}d}}}.tar"
+    print(
+        json.dumps(
+            {
+                "samples": len(files),
+                "classes": len(classes),
+                "shards": n_shards,
+                "spec": spec,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
