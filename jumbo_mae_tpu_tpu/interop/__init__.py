@@ -1,6 +1,17 @@
+from jumbo_mae_tpu_tpu.interop.reference_convert import (
+    reference_encoder_to_jumbo,
+    reference_head_batch_stats_to_jumbo,
+    reference_pretrain_to_jumbo,
+)
 from jumbo_mae_tpu_tpu.interop.torch_convert import (
     flax_to_torch_state,
     torch_to_flax_params,
 )
 
-__all__ = ["flax_to_torch_state", "torch_to_flax_params"]
+__all__ = [
+    "flax_to_torch_state",
+    "torch_to_flax_params",
+    "reference_encoder_to_jumbo",
+    "reference_head_batch_stats_to_jumbo",
+    "reference_pretrain_to_jumbo",
+]

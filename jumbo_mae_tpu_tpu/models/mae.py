@@ -103,12 +103,16 @@ class MAEPretrainModel(nn.Module):
         images: jax.Array,
         deterministic: bool = True,
         return_reconstruction: bool = False,
+        *,
+        mask_noise: jax.Array | None = None,
     ):
         enc_cfg = self.encoder_cfg
         k = enc_cfg.num_cls_tokens
         images = normalize_images(images, dtype=enc_cfg.compute_dtype)
 
-        tokens, mask, ids_restore = self.encoder(images, deterministic)
+        tokens, mask, ids_restore = self.encoder(
+            images, deterministic, mask_noise=mask_noise
+        )
         tokens = self.decoder_proj(tokens)
         cls, visible = tokens[:, :k, :], tokens[:, k:, :]
 

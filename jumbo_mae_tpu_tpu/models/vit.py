@@ -72,7 +72,13 @@ class JumboViT(nn.Module):
     def mae_mode(self) -> bool:
         return self.head is None and self.cfg.mask_ratio is not None
 
-    def __call__(self, images: jax.Array, deterministic: bool = True):
+    def __call__(
+        self,
+        images: jax.Array,
+        deterministic: bool = True,
+        *,
+        mask_noise: jax.Array | None = None,
+    ):
         cfg = self.cfg
         k = cfg.num_cls_tokens
         x = self.embed(images)
@@ -80,11 +86,13 @@ class JumboViT(nn.Module):
 
         mask = ids_restore = None
         if self.mae_mode:
+            rng = None if mask_noise is not None else self.make_rng("noise")
             x, mask, ids_restore = random_masking(
                 x,
-                self.make_rng("noise"),
+                rng,
                 cfg.keep_len,
                 mode=cfg.mask_mode,
+                noise=mask_noise,
             )
 
         cls = jnp.broadcast_to(

@@ -38,10 +38,11 @@ def index_sequence(x: jax.Array, ids: jax.Array) -> jax.Array:
 
 def random_masking(
     x: jax.Array,
-    rng: jax.Array,
+    rng: jax.Array | None,
     keep_len: int,
     *,
     mode: MaskMode = "shared",
+    noise: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Randomly drop all but ``keep_len`` tokens of ``x`` (batch, len, dim).
 
@@ -49,10 +50,22 @@ def random_masking(
     ``(batch, keep_len, dim)``, ``mask`` is ``(batch, len)`` float32 with 1 at
     MASKED positions, and ``ids_restore`` inverts the shuffle (1-D in shared
     mode, 2-D in per-sample mode).
+
+    ``noise`` optionally overrides the drawn uniform noise (shape ``(len,)``
+    shared / ``(batch, len)`` per-sample) so a caller can pin the permutation
+    — used for fixed eval masks and cross-implementation parity tests; ``rng``
+    may then be None.
     """
     batch, length, _ = x.shape
+    expected = (length,) if mode == "shared" else (batch, length)
+    if noise is not None and noise.shape != expected:
+        raise ValueError(
+            f"injected noise shape {noise.shape} != {expected} for "
+            f"mode={mode!r}"
+        )
     if mode == "shared":
-        noise = jax.random.uniform(rng, (length,), dtype=jnp.float32)
+        if noise is None:
+            noise = jax.random.uniform(rng, (length,), dtype=jnp.float32)
         ids_shuffle = jnp.argsort(noise)
         ids_restore = jnp.argsort(ids_shuffle)
         kept = index_sequence(x, ids_shuffle[:keep_len])
@@ -61,7 +74,8 @@ def random_masking(
         return kept, mask, ids_restore
 
     if mode == "per_sample":
-        noise = jax.random.uniform(rng, (batch, length), dtype=jnp.float32)
+        if noise is None:
+            noise = jax.random.uniform(rng, (batch, length), dtype=jnp.float32)
         ids_shuffle = jnp.argsort(noise, axis=1)
         ids_restore = jnp.argsort(ids_shuffle, axis=1)
         kept = index_sequence(x, ids_shuffle[:, :keep_len])

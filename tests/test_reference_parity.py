@@ -1,0 +1,271 @@
+"""Direct numerical parity against the reference implementation.
+
+The reference model zoo is importable flax (``/root/reference/src/modeling.py``,
+reviewed read-only: pure module definitions, no import-time side effects).
+These tests init the REFERENCE modules, convert their param trees with
+``interop.reference_convert``, load them into this framework's modules, and
+assert forward outputs match in float32 — upgrading the re-derived-oracle
+parity story to direct proof (VERDICT round 1, item 3).
+
+Import shims: reference ``utils.py`` imports ``webdataset`` and reference
+``pretraining.py`` imports ``dataset`` (webdataset/torchvision/timm, not
+installed here). Neither dependency is touched by the model code paths, so
+minimal stub modules are injected. The normalization constants the stub
+provides are asserted equal to this package's.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.interop import (
+    flax_to_torch_state,
+    reference_encoder_to_jumbo,
+    reference_pretrain_to_jumbo,
+    torch_to_flax_params,
+)
+from jumbo_mae_tpu_tpu.models import (
+    DecoderConfig,
+    JumboViT,
+    JumboViTConfig,
+    MAEPretrainModel,
+)
+from jumbo_mae_tpu_tpu.ops.preprocess import IMAGENET_MEAN, IMAGENET_STD
+
+REF_SRC = "/root/reference/src"
+
+
+@pytest.fixture(scope="module")
+def ref():
+    """Import the reference modules with missing-dependency stubs."""
+    if "webdataset" not in sys.modules:
+        sys.modules["webdataset"] = types.ModuleType("webdataset")
+    if "dataset" not in sys.modules:
+        ds = types.ModuleType("dataset")
+        ds.IMAGENET_DEFAULT_MEAN = np.array([0.485, 0.456, 0.406])
+        ds.IMAGENET_DEFAULT_STD = np.array([0.229, 0.224, 0.225])
+        sys.modules["dataset"] = ds
+    np.testing.assert_allclose(
+        IMAGENET_MEAN, sys.modules["dataset"].IMAGENET_DEFAULT_MEAN
+    )
+    np.testing.assert_allclose(
+        IMAGENET_STD, sys.modules["dataset"].IMAGENET_DEFAULT_STD
+    )
+    sys.path.insert(0, REF_SRC)
+    try:
+        import modeling as ref_modeling
+        import pretraining as ref_pretraining
+
+        yield types.SimpleNamespace(
+            modeling=ref_modeling, pretraining=ref_pretraining
+        )
+    finally:
+        sys.path.remove(REF_SRC)
+
+
+# Tiny but structurally complete: multiple blocks (shared jumbo MLP reuse),
+# layerscale on, learnable posemb in classify / sincos2d in MAE.
+LAYERS, DIM, HEADS, LABELS = 2, 48, 4, 11
+IMAGE, PATCH = 64, 16  # grid 4x4, N=16: int(N*.75)+int(N*.25) == N
+
+
+def _my_cfg(**kw) -> JumboViTConfig:
+    return JumboViTConfig(
+        layers=LAYERS,
+        dim=DIM,
+        heads=HEADS,
+        image_size=IMAGE,
+        patch_size=PATCH,
+        layerscale=True,
+        dtype="float32",
+        **kw,
+    )
+
+
+def _ref_vit(ref, **kw):
+    return ref.modeling.ViT(
+        layers=LAYERS,
+        dim=DIM,
+        heads=HEADS,
+        image_size=IMAGE,
+        patch_size=PATCH,
+        layerscale=True,
+        **kw,
+    )
+
+
+def test_classify_forward_parity(ref):
+    """Converted reference weights → identical logits, incl. a round trip
+    through the torch-layout converters on the way."""
+    ref_model = _ref_vit(
+        ref, labels=LABELS, posemb="learnable", image_mask_ratio=None
+    )
+    images = jax.random.normal(jax.random.key(0), (3, IMAGE, IMAGE, 3))
+    variables = ref_model.init(jax.random.key(1), images)
+    ref_logits = ref_model.apply(variables, images)
+
+    params = reference_encoder_to_jumbo(variables["params"])
+    # Chain through the torch converters too: proves the full migration path
+    # reference-flax → jumbo-flax → torch → jumbo-flax is lossless.
+    torch_state = flax_to_torch_state({"encoder": params})
+    params_rt = torch_to_flax_params(torch_state, heads=HEADS)
+    chex_trees_equal = jax.tree_util.tree_all(
+        jax.tree_util.tree_map(
+            lambda a, b: np.array_equal(np.asarray(a), np.asarray(b)),
+            params,
+            params_rt,
+        )
+    )
+    assert chex_trees_equal, "torch round trip altered the converted tree"
+
+    my_model = JumboViT(_my_cfg(labels=LABELS, posemb="learnable"))
+    my_logits = my_model.apply({"params": params_rt}, images)
+
+    np.testing.assert_allclose(
+        np.asarray(my_logits), np.asarray(ref_logits), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_linear_probe_batchnorm_parity(ref):
+    """Linear-probe mode: BatchNorm running stats and probe head convert and
+    produce identical logits under ``deterministic`` inference."""
+    from jumbo_mae_tpu_tpu.interop import reference_head_batch_stats_to_jumbo
+
+    ref_model = _ref_vit(
+        ref,
+        labels=LABELS,
+        posemb="learnable",
+        image_mask_ratio=None,
+        linear_probing=True,
+        batch_norm=True,
+    )
+    images = jax.random.normal(jax.random.key(9), (3, IMAGE, IMAGE, 3))
+    variables = ref_model.init(jax.random.key(10), images)
+    # give the running stats non-trivial values so the test can't pass on
+    # zero-mean/unit-var defaults
+    batch_stats = jax.tree_util.tree_map(
+        lambda x: x + 0.3, variables["batch_stats"]
+    )
+    ref_logits = ref_model.apply(
+        {"params": variables["params"], "batch_stats": batch_stats}, images
+    )
+
+    params = reference_encoder_to_jumbo(variables["params"])
+    my_stats = reference_head_batch_stats_to_jumbo(batch_stats)
+    my_model = JumboViT(
+        _my_cfg(
+            labels=LABELS,
+            posemb="learnable",
+            linear_probing=True,
+            batch_norm=True,
+        )
+    )
+    my_logits = my_model.apply(
+        {"params": params, "batch_stats": my_stats}, images
+    )
+    np.testing.assert_allclose(
+        np.asarray(my_logits), np.asarray(ref_logits), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_mae_encoder_masking_parity(ref):
+    """MAE mode: same "noise" key at the root → identical mask, restore ids,
+    and encoded visible tokens."""
+    ref_model = _ref_vit(ref, labels=-1, posemb="sincos2d", image_mask_ratio=0.75)
+    images = jax.random.normal(jax.random.key(2), (2, IMAGE, IMAGE, 3))
+    variables = ref_model.init(
+        {"params": jax.random.key(3), "noise": jax.random.key(4)}, images
+    )
+    noise_key = jax.random.key(5)
+    ref_tokens, ref_mask, ref_restore = ref_model.apply(
+        variables, images, rngs={"noise": noise_key}
+    )
+
+    params = reference_encoder_to_jumbo(variables["params"])
+    my_model = JumboViT(
+        _my_cfg(labels=None, posemb="sincos2d", mask_ratio=0.75)
+    )
+    my_tokens, my_mask, my_restore = my_model.apply(
+        {"params": params}, images, rngs={"noise": noise_key}
+    )
+
+    np.testing.assert_array_equal(np.asarray(my_restore), np.asarray(ref_restore))
+    np.testing.assert_array_equal(np.asarray(my_mask), np.asarray(ref_mask))
+    np.testing.assert_allclose(
+        np.asarray(my_tokens), np.asarray(ref_tokens), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.parametrize("norm_pix_loss", [False, True])
+def test_mae_pretrain_loss_parity(ref, norm_pix_loss):
+    """Full pretrain pipeline: same weights + same mask permutation → same
+    masked-MSE loss.
+
+    The two implementations derive their internal mask RNG through different
+    module paths (flax folds module names into ``make_rng``), so the
+    reference's actually-used permutation is extracted via ``bind`` and
+    injected into this model through ``mask_noise``.
+    """
+    ref_vit = _ref_vit(ref, labels=-1, posemb="sincos2d", image_mask_ratio=0.75)
+    ref_dec = ref.modeling.MAEDecoder(
+        dec_layers=2,
+        dec_dim=32,
+        dec_heads=4,
+        dec_layerscale=True,
+        image_size=IMAGE,
+        patch_size=PATCH,
+    )
+    ref_module = ref.pretraining.PretrainModule(
+        model=ref_vit,
+        decoder_model=ref_dec,
+        image_size=IMAGE,
+        norm_pix_loss=norm_pix_loss,
+    )
+    images_nchw = np.random.RandomState(0).randint(
+        0, 256, (2, 3, IMAGE, IMAGE), dtype=np.uint8
+    )
+    variables = ref_module.init(
+        {"params": jax.random.key(6), "noise": jax.random.key(7)}, images_nchw
+    )
+    noise_key = jax.random.key(8)
+    ref_loss = ref_module.apply(variables, images_nchw, rngs={"noise": noise_key})[
+        "loss"
+    ]
+
+    # Recover the permutation the reference just used: bind replays the same
+    # scope path + rng fold as the real apply.
+    bound = ref_module.bind(variables, rngs={"noise": noise_key})
+    normalized = jnp.moveaxis(images_nchw, 1, 3).astype(jnp.float32) / 0xFF
+    normalized = (
+        normalized - sys.modules["dataset"].IMAGENET_DEFAULT_MEAN
+    ) / sys.modules["dataset"].IMAGENET_DEFAULT_STD
+    _, ref_mask, ref_restore = bound.model(normalized, det=False)
+    # a noise vector whose argsort reproduces the permutation
+    injected_noise = jnp.asarray(ref_restore, jnp.float32) / ref_restore.shape[0]
+
+    params = reference_pretrain_to_jumbo(variables["params"])
+    my_model = MAEPretrainModel(
+        _my_cfg(labels=None, posemb="sincos2d", mask_ratio=0.75),
+        DecoderConfig(
+            layers=2, dim=32, heads=4, layerscale=True, dtype="float32"
+        ),
+        norm_pix_loss=norm_pix_loss,
+    )
+    images_nhwc = images_nchw.transpose(0, 2, 3, 1)
+    out = my_model.apply(
+        {"params": params},
+        images_nhwc,
+        return_reconstruction=True,
+        mask_noise=injected_noise,
+    )
+
+    np.testing.assert_array_equal(np.asarray(out["mask"]), np.asarray(ref_mask))
+    np.testing.assert_allclose(
+        float(out["loss"]), float(ref_loss), atol=1e-5, rtol=1e-5
+    )
