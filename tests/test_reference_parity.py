@@ -41,22 +41,29 @@ from jumbo_mae_tpu_tpu.ops.preprocess import IMAGENET_MEAN, IMAGENET_STD
 REF_SRC = "/root/reference/src"
 
 
+IMAGENET_DEFAULT_MEAN = np.array([0.485, 0.456, 0.406])
+IMAGENET_DEFAULT_STD = np.array([0.229, 0.224, 0.225])
+
+
 @pytest.fixture(scope="module")
 def ref():
-    """Import the reference modules with missing-dependency stubs."""
-    if "webdataset" not in sys.modules:
+    """Import the reference modules with missing-dependency stubs; everything
+    injected into sys.modules/sys.path is removed afterwards (the reference's
+    top-level names — modeling, utils, dataset … — are too generic to leak)."""
+    np.testing.assert_allclose(IMAGENET_MEAN, IMAGENET_DEFAULT_MEAN)
+    np.testing.assert_allclose(IMAGENET_STD, IMAGENET_DEFAULT_STD)
+
+    injected = [
+        m for m in ("webdataset", "dataset") if m not in sys.modules
+    ]
+    if "webdataset" in injected:
         sys.modules["webdataset"] = types.ModuleType("webdataset")
-    if "dataset" not in sys.modules:
+    if "dataset" in injected:
         ds = types.ModuleType("dataset")
-        ds.IMAGENET_DEFAULT_MEAN = np.array([0.485, 0.456, 0.406])
-        ds.IMAGENET_DEFAULT_STD = np.array([0.229, 0.224, 0.225])
+        ds.IMAGENET_DEFAULT_MEAN = IMAGENET_DEFAULT_MEAN
+        ds.IMAGENET_DEFAULT_STD = IMAGENET_DEFAULT_STD
         sys.modules["dataset"] = ds
-    np.testing.assert_allclose(
-        IMAGENET_MEAN, sys.modules["dataset"].IMAGENET_DEFAULT_MEAN
-    )
-    np.testing.assert_allclose(
-        IMAGENET_STD, sys.modules["dataset"].IMAGENET_DEFAULT_STD
-    )
+    before = set(sys.modules)
     sys.path.insert(0, REF_SRC)
     try:
         import modeling as ref_modeling
@@ -67,6 +74,8 @@ def ref():
         )
     finally:
         sys.path.remove(REF_SRC)
+        for m in injected + sorted(set(sys.modules) - before):
+            sys.modules.pop(m, None)
 
 
 # Tiny but structurally complete: multiple blocks (shared jumbo MLP reuse),
@@ -115,14 +124,15 @@ def test_classify_forward_parity(ref):
     # reference-flax → jumbo-flax → torch → jumbo-flax is lossless.
     torch_state = flax_to_torch_state({"encoder": params})
     params_rt = torch_to_flax_params(torch_state, heads=HEADS)
-    chex_trees_equal = jax.tree_util.tree_all(
-        jax.tree_util.tree_map(
-            lambda a, b: np.array_equal(np.asarray(a), np.asarray(b)),
-            params,
-            params_rt,
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_rt = jax.tree_util.tree_flatten_with_path(params_rt)[0]
+    assert [p for p, _ in flat] == [p for p, _ in flat_rt]
+    for (path, a), (_, b) in zip(flat, flat_rt):
+        np.testing.assert_array_equal(
+            np.asarray(a),
+            np.asarray(b),
+            err_msg=f"torch round trip altered {jax.tree_util.keystr(path)}",
         )
-    )
-    assert chex_trees_equal, "torch round trip altered the converted tree"
 
     my_model = JumboViT(_my_cfg(labels=LABELS, posemb="learnable"))
     my_logits = my_model.apply({"params": params_rt}, images)
@@ -242,9 +252,7 @@ def test_mae_pretrain_loss_parity(ref, norm_pix_loss):
     # scope path + rng fold as the real apply.
     bound = ref_module.bind(variables, rngs={"noise": noise_key})
     normalized = jnp.moveaxis(images_nchw, 1, 3).astype(jnp.float32) / 0xFF
-    normalized = (
-        normalized - sys.modules["dataset"].IMAGENET_DEFAULT_MEAN
-    ) / sys.modules["dataset"].IMAGENET_DEFAULT_STD
+    normalized = (normalized - IMAGENET_DEFAULT_MEAN) / IMAGENET_DEFAULT_STD
     _, ref_mask, ref_restore = bound.model(normalized, det=False)
     # a noise vector whose argsort reproduces the permutation
     injected_noise = jnp.asarray(ref_restore, jnp.float32) / ref_restore.shape[0]
