@@ -83,17 +83,27 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
     return step, state, batch
 
 
-def time_steps(step, state, batch, *, warmup: int, iters: int) -> float:
+def time_steps(step, state, batch, *, warmup: int, iters: int, rounds: int = 3) -> float:
+    """Best-of-``rounds`` mean step time over ``iters`` chained async steps.
+
+    Each round dispatches ``iters`` steps back-to-back with ONE final
+    block_until_ready (steady-state pattern; per-step sync would add the
+    ~130 ms tunnel round-trip). The min across rounds rejects interference
+    noise on the shared remote chip — both bench legs get identical
+    treatment so the ratio is defensible."""
     import jax
 
     for _ in range(warmup):
         state, metrics = step(state, batch)
     jax.block_until_ready(metrics["loss"])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    return (time.perf_counter() - t0) / iters
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
 
 
 def main():
@@ -110,22 +120,23 @@ def main():
     imgs_per_sec = batch_size / dt
     del step, state
 
-    ratio = None
+    result = {
+        "metric": f"mae_{model}_224_pretrain_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": None,
+        "ms_step_bf16": round(dt * 1e3, 2),
+    }
     if not os.environ.get("BENCH_SKIP_BASELINE"):
+        # The baseline leg (reference-style fp32 compute, same workload)
+        # gets IDENTICAL warmup/iters/rounds so the ratio is two equally
+        # converged measurements, not a converged one over a noisy one.
         step_f32, state_f32, batch = build_step("float32", batch_size, model)
-        dt_f32 = time_steps(step_f32, state_f32, batch, warmup=2, iters=max(4, iters // 2))
-        ratio = round(dt_f32 / dt, 3)
+        dt_f32 = time_steps(step_f32, state_f32, batch, warmup=3, iters=iters)
+        result["vs_baseline"] = round(dt_f32 / dt, 3)
+        result["ms_step_f32"] = round(dt_f32 * 1e3, 2)
 
-    print(
-        json.dumps(
-            {
-                "metric": f"mae_{model}_224_pretrain_imgs_per_sec_per_chip",
-                "value": round(imgs_per_sec, 2),
-                "unit": "imgs/sec/chip",
-                "vs_baseline": ratio,
-            }
-        )
-    )
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

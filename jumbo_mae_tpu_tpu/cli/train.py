@@ -23,7 +23,12 @@ from pathlib import Path
 import jax
 import numpy as np
 
-from jumbo_mae_tpu_tpu.config import TrainConfig, config_to_dict, load_config
+from jumbo_mae_tpu_tpu.config import (
+    IMAGENET_TRAIN_SIZE,
+    TrainConfig,
+    config_to_dict,
+    load_config,
+)
 from jumbo_mae_tpu_tpu.data import (
     DataConfig,
     TrainLoader,
@@ -113,6 +118,19 @@ def make_train_iterator(cfg: TrainConfig, mesh, per_process: int, start_step: in
     start_epoch = (start_step * cfg.run.train_batch_size) // max(
         1, cfg.data.dataset_size * max(1, cfg.data.repeats)
     )
+    if start_step > 0:
+        if (
+            cfg.data.dataset_size == IMAGENET_TRAIN_SIZE
+            and cfg.data.train_shards
+            and "imagenet" not in str(cfg.data.train_shards).lower()
+        ):
+            print(
+                "[train] WARNING: resuming with the default (ImageNet) "
+                "data.dataset_size but custom train_shards — if the real "
+                "dataset is smaller, the resume epoch below is wrong; set "
+                "data.dataset_size explicitly"
+            )
+        print(f"[train] data cursor: resuming stream at epoch {start_epoch}")
     if cfg.run.synthetic_data:
         it = synthetic_batches(
             per_process,
@@ -262,6 +280,10 @@ def train(cfg: TrainConfig) -> dict:
         config=config_to_dict(cfg),
         enabled=is_main,
         use_wandb=run.use_wandb,
+        wandb_project=run.wandb_project,
+        wandb_entity=run.wandb_entity,
+        wandb_tags=tuple(run.wandb_tags),
+        wandb_id=run.wandb_id,
     )
     valid_factory = make_valid_iterator(cfg, mesh, per_process_valid)
     # all-padding eval batch, pre-sharded by EVERY process at setup so

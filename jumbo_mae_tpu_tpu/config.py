@@ -72,6 +72,10 @@ class RunConfig:
     pretrained_ckpt: str = ""
     profile_dir: str = ""
     use_wandb: bool = True
+    wandb_project: str = ""
+    wandb_entity: str = ""
+    wandb_tags: tuple = ()
+    wandb_id: str = ""  # stable id → resume the same wandb run on restart
 
 
 @dataclass(frozen=True)
@@ -126,7 +130,12 @@ def _resolve_epochs(doc: dict) -> dict:
     # and the resume data cursor (cli/train.py).
     top_level = doc.pop("dataset_size", None)
     data_sec = doc.setdefault("data", {})
-    dataset = data_sec.get("dataset_size", top_level) or IMAGENET_TRAIN_SIZE
+    dataset = data_sec.get("dataset_size", top_level)
+    if dataset is None:
+        dataset = IMAGENET_TRAIN_SIZE
+    elif not isinstance(dataset, int) or isinstance(dataset, bool) or dataset <= 0:
+        # it feeds both epochs→steps and the resume cursor — fail loudly
+        raise ValueError(f"dataset_size must be a positive int, got {dataset!r}")
     data_sec["dataset_size"] = dataset
     if "epochs" in run:
         run["training_steps"] = steps_from_epochs(run.pop("epochs"), batch, dataset)

@@ -65,14 +65,17 @@ class Attention(nn.Module):
         # fits) — because the Pallas forward pairs with a slower blockwise
         # backward (PERF.md §decisions). "flash" stays an explicit opt-in
         # for memory regimes where the score tensor cannot exist at all.
-        if cfg.attn_impl in ("flash", "ring") and cfg.dropout > 0.0:
+        if cfg.attn_impl in ("flash", "ring") and cfg.dropout > 0.0 and not deterministic:
             # Both are explicit requests — "ring" for sequence parallelism,
             # "flash" for O(S) score memory; silently degrading either to
             # the O(S²) einsum path would defeat the reason it was chosen.
+            # Deterministic (inference) calls are fine: dropout is inactive,
+            # so a model trained with einsum+dropout can still evaluate with
+            # flash/ring.
             raise ValueError(
                 f"attn_impl={cfg.attn_impl!r} has no attention-probability "
-                "dropout; set dropout=0.0 (droppath regularization still "
-                "applies)"
+                "dropout; set dropout=0.0 to train (droppath regularization "
+                "still applies)"
             )
         if cfg.attn_impl == "ring":
             # Sequence parallelism: tokens shard over the ambient mesh's

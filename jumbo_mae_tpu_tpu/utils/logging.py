@@ -23,6 +23,10 @@ class MetricLogger:
         config: dict | None = None,
         enabled: bool = True,
         use_wandb: bool = True,
+        wandb_project: str = "",
+        wandb_entity: str = "",
+        wandb_tags: tuple[str, ...] = (),
+        wandb_id: str = "",
     ):
         self.enabled = enabled
         self._file = None
@@ -38,11 +42,25 @@ class MetricLogger:
                     json.dumps(config, indent=2, default=str)
                 )
         if use_wandb:
-            try:  # pragma: no cover - wandb absent in CI
+            kwargs: dict = {"name": name, "config": config or {}}
+            if wandb_project:
+                kwargs["project"] = wandb_project
+            if wandb_entity:
+                kwargs["entity"] = wandb_entity
+            if wandb_tags:
+                kwargs["tags"] = list(wandb_tags)
+            if wandb_id:
+                # stable id → wandb resumes the run after a restart
+                kwargs["id"] = wandb_id
+                kwargs["resume"] = "allow"
+            try:
                 import wandb
 
-                self._wandb = wandb.init(name=name, config=config or {})
-            except Exception:  # noqa: BLE001
+                self._wandb = wandb.init(**kwargs)
+            except ImportError:
+                self._wandb = None  # JSONL-only environments are expected
+            except Exception as e:  # noqa: BLE001
+                print(f"[logging] wandb.init failed ({e}); JSONL only")
                 self._wandb = None
 
     def log(self, metrics: dict, step: int | None = None):

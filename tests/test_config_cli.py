@@ -54,6 +54,12 @@ def test_dataset_size_single_source_of_truth():
     assert cfg2.data.dataset_size == 500
 
 
+def test_dataset_size_rejects_non_positive():
+    for bad in (0, -5, 1.5, "lots", True):
+        with pytest.raises(ValueError, match="dataset_size"):
+            config_from_dict({"data": {"dataset_size": bad}})
+
+
 def test_overrides_dotted_paths():
     doc = apply_overrides({}, ["optim.learning_rate=1e-3", "run.mode=finetune"])
     cfg = config_from_dict(doc)

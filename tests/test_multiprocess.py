@@ -68,6 +68,9 @@ def test_two_process_train_and_eval_match_single_process(shards, tmp_path):
     env["PYTHONPATH"] = f"{REPO}:{Path(__file__).parent}"
 
     port = _free_port()
+    # log to files, not PIPE: an undrained pipe buffer would deadlock a
+    # chatty worker (XLA/gloo warnings) against the poll loop below
+    logs = [open(tmp_path / f"worker{pid}.log", "w+") for pid in (0, 1)]
     procs = [
         subprocess.Popen(
             [
@@ -80,11 +83,11 @@ def test_two_process_train_and_eval_match_single_process(shards, tmp_path):
                 shards,
             ],
             env=env,
-            stdout=subprocess.PIPE,
+            stdout=log,
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for pid in (0, 1)
+        for pid, log in zip((0, 1), logs)
     ]
     # fail fast: if one worker dies (e.g. before reaching the distributed-init
     # barrier), kill the survivor instead of waiting out its timeout
@@ -100,7 +103,12 @@ def test_two_process_train_and_eval_match_single_process(shards, tmp_path):
                     q.kill()
             break
         time.sleep(0.5)
-    outputs = [p.communicate()[0] for p in procs]
+    outputs = []
+    for p, log in zip(procs, logs):
+        p.wait()
+        log.seek(0)
+        outputs.append(log.read())
+        log.close()
     for p, out in zip(procs, outputs):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
 

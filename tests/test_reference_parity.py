@@ -63,7 +63,6 @@ def ref():
         ds.IMAGENET_DEFAULT_MEAN = IMAGENET_DEFAULT_MEAN
         ds.IMAGENET_DEFAULT_STD = IMAGENET_DEFAULT_STD
         sys.modules["dataset"] = ds
-    before = set(sys.modules)
     sys.path.insert(0, REF_SRC)
     try:
         import modeling as ref_modeling
@@ -74,7 +73,9 @@ def ref():
         )
     finally:
         sys.path.remove(REF_SRC)
-        for m in injected + sorted(set(sys.modules) - before):
+        # only the reference's generic top-level names + our stubs — not the
+        # transitive third-party imports, which must stay singletons
+        for m in injected + ["modeling", "pretraining", "utils", "utils_mae"]:
             sys.modules.pop(m, None)
 
 

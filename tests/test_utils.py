@@ -75,6 +75,72 @@ def test_metric_logger_jsonl(tmp_path):
     assert json.loads((tmp_path / "t-config.json").read_text()) == {"a": 1}
 
 
+def test_metric_logger_wandb_plumbing(tmp_path, monkeypatch):
+    """project/entity/tags/resume-id reach wandb.init; logs are forwarded."""
+    import sys
+    import types
+
+    calls = {}
+
+    class _Run:
+        def log(self, metrics, step=None):
+            calls.setdefault("logged", []).append((dict(metrics), step))
+
+        def finish(self):
+            calls["finished"] = True
+
+    def _init(**kw):
+        calls["init"] = kw
+        return _Run()
+
+    stub = types.ModuleType("wandb")
+    stub.init = _init
+    monkeypatch.setitem(sys.modules, "wandb", stub)
+
+    logger = MetricLogger(
+        tmp_path,
+        name="t",
+        config={"a": 1},
+        wandb_project="proj",
+        wandb_entity="team",
+        wandb_tags=("vit", "mae"),
+        wandb_id="run-123",
+    )
+    logger.log({"loss": 1.0}, step=1)
+    logger.close()
+
+    assert calls["init"] == {
+        "name": "t",
+        "config": {"a": 1},
+        "project": "proj",
+        "entity": "team",
+        "tags": ["vit", "mae"],
+        "id": "run-123",
+        "resume": "allow",
+    }
+    assert calls["logged"] == [({"loss": 1.0}, 1)]
+    assert calls["finished"]
+
+
+def test_metric_logger_wandb_absent_falls_back(tmp_path, monkeypatch):
+    import builtins
+    import sys
+
+    monkeypatch.delitem(sys.modules, "wandb", raising=False)
+    real_import = builtins.__import__
+
+    def no_wandb(name, *a, **k):
+        if name == "wandb":
+            raise ImportError("no wandb")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_wandb)
+    logger = MetricLogger(tmp_path, name="fb", use_wandb=True)
+    logger.log({"x": 1.0}, step=1)
+    logger.close()
+    assert (tmp_path / "fb-metrics.jsonl").exists()
+
+
 def test_metric_logger_disabled(tmp_path):
     logger = MetricLogger(tmp_path, name="off", enabled=False)
     logger.log({"x": 1})

@@ -134,6 +134,8 @@ def main():
     shards = 4
     if args.images < shards:
         ap.error(f"--images must be ≥ {shards} (one sample per shard minimum)")
+    if args.images % shards:
+        ap.error(f"--images must be a multiple of {shards} (shard count)")
     spec = build_shards(
         root, shards=shards, per_shard=args.images // shards, size=args.size
     )
