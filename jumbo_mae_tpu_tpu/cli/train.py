@@ -109,16 +109,32 @@ def _strip_for_model(cfg: TrainConfig, batch: dict) -> dict:
     return {k: batch[k] for k in ("images", "labels") if k in batch}
 
 
-def make_train_iterator(cfg: TrainConfig, mesh, per_process: int, start_step: int = 0):
-    # Coarse data-cursor resume: restart the deterministic stream at the
-    # epoch the resumed step falls in (per-epoch shard order and shuffles
-    # are keyed on (seed, epoch), so no sample skipping is needed). One
-    # stream epoch yields dataset_size × repeats samples (repeated
-    # augmentation clones count toward the batch).
+def make_train_iterator(
+    cfg: TrainConfig,
+    mesh,
+    per_process: int,
+    start_step: int = 0,
+    data_cursor: dict | None = None,
+):
+    """Build the device-prefetched train iterator.
+
+    Resume: with a checkpointed ``data_cursor`` the loader continues the
+    deterministic stream sample-exactly (per-worker epoch/offset + the
+    round-robin phase). Without one (old checkpoint, changed worker count)
+    it falls back to the coarse epoch cursor: restart the stream at the
+    epoch the resumed step falls in — per-epoch shard order and shuffles are
+    keyed on (seed, epoch), so no sample skipping is needed. One stream
+    epoch yields dataset_size × repeats samples (repeated augmentation
+    clones count toward the batch).
+
+    Returns ``(iterator, source, cursor_log)`` — ``cursor_log`` maps each
+    absolute step to the loader snapshot after that step's batch left the
+    loader (prefetch-safe: recorded at loader exit, consumed by step index).
+    """
     start_epoch = (start_step * cfg.run.train_batch_size) // max(
         1, cfg.data.dataset_size * max(1, cfg.data.repeats)
     )
-    if start_step > 0:
+    if start_step > 0 and data_cursor is None:
         if (
             cfg.data.dataset_size == IMAGENET_TRAIN_SIZE
             and cfg.data.train_shards
@@ -131,6 +147,7 @@ def make_train_iterator(cfg: TrainConfig, mesh, per_process: int, start_step: in
                 "data.dataset_size explicitly"
             )
         print(f"[train] data cursor: resuming stream at epoch {start_epoch}")
+    cursor_log: dict[int, dict] = {}
     if cfg.run.synthetic_data:
         it = synthetic_batches(
             per_process,
@@ -141,18 +158,58 @@ def make_train_iterator(cfg: TrainConfig, mesh, per_process: int, start_step: in
         )
         source = None
     else:
-        source = TrainLoader(
-            cfg.data,
-            per_process,
+        # The checkpoint records every process's cursor plus the saving
+        # topology (the saved JSON is host-0's); sample-exact resume is only
+        # valid with the SAME process count — shard stripes and per-process
+        # batch sizes are topology-dependent — so any mismatch drops every
+        # process to epoch resume together (a mixed schedule would be
+        # globally inconsistent).
+        if data_cursor is not None:
+            saved_pc = int(data_cursor.get("process_count", 1))
+            if saved_pc != jax.process_count():
+                print(
+                    f"[train] WARNING: checkpoint data cursor was saved with "
+                    f"{saved_pc} processes but this run has "
+                    f"{jax.process_count()}; falling back to epoch resume"
+                )
+                data_cursor = None
+            elif "per_process" in data_cursor:
+                data_cursor = {
+                    "workers": data_cursor["per_process"][jax.process_index()],
+                    "batches": data_cursor["batches"],
+                }
+        loader_kwargs = dict(
             process_index=jax.process_index(),
             process_count=jax.process_count(),
             start_epoch=start_epoch,
         )
-        it = (split_for_accum(b, cfg.run.grad_accum) for b in source)
+        try:
+            source = TrainLoader(
+                cfg.data, per_process, cursor=data_cursor, **loader_kwargs
+            )
+            if data_cursor is not None:
+                print(
+                    "[train] data cursor: sample-exact resume at epoch/offset "
+                    f"{data_cursor['workers']}"
+                )
+        except ValueError as e:
+            if data_cursor is None:
+                raise
+            print(f"[train] WARNING: {e}; falling back to epoch-{start_epoch} resume")
+            source = TrainLoader(cfg.data, per_process, **loader_kwargs)
+
+        def tracked():
+            step = start_step
+            for b in source:
+                step += 1
+                cursor_log[step] = source.snapshot()
+                yield b
+
+        it = (split_for_accum(b, cfg.run.grad_accum) for b in tracked())
     it = ({k: v for k, v in b.items() if k != "valid"} for b in it)
     it = (_strip_for_model(cfg, b) for b in it)
     sharding = batch_sharding(mesh, accum=cfg.run.grad_accum > 1)
-    return prefetch_to_device(it, sharding), source
+    return prefetch_to_device(it, sharding), source, cursor_log
 
 
 def make_valid_iterator(cfg: TrainConfig, mesh, per_process: int):
@@ -181,6 +238,27 @@ def make_valid_iterator(cfg: TrainConfig, mesh, per_process: int):
         ),
         sharding,
     )
+
+
+def _gather_data_cursor(snap: dict | None) -> dict | None:
+    """Make a loader snapshot checkpoint-safe under multi-host: Orbax's JSON
+    payload is host-0's, so every process's cursor is all-gathered into it
+    (``per_process``); restore picks the entry for ``jax.process_index()``.
+    Collective — every process must call this at the same step."""
+    if snap is None:
+        return None
+    if jax.process_count() == 1:
+        return {**snap, "process_count": 1}
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray(snap["workers"], np.int64)
+    )
+    return {
+        "per_process": gathered.tolist(),
+        "batches": snap["batches"],
+        "process_count": jax.process_count(),
+    }
 
 
 def evaluate(eval_step, state, batches, pad_batch: dict | None = None) -> dict[str, float]:
@@ -262,9 +340,11 @@ def train(cfg: TrainConfig) -> dict:
 
     ckpt = Checkpointer(cfg.checkpoint_config())
     start_step = 0
+    data_cursor = None
     if run.resume and ckpt.latest_step() is not None:
         state, extra = ckpt.restore(state, sharding=state_sharding)
         start_step = int(state.step)
+        data_cursor = extra.get("data_cursor")
         print(f"[train] resumed from step {start_step}")
 
     mode_key = "pretrain" if run.mode == "pretrain" else "classify"
@@ -306,7 +386,9 @@ def train(cfg: TrainConfig) -> dict:
             evaluate(eval_step, state, valid_factory(), pad_batch),
         )
 
-    train_iter, source = make_train_iterator(cfg, mesh, per_process, start_step)
+    train_iter, source, cursor_log = make_train_iterator(
+        cfg, mesh, per_process, start_step, data_cursor
+    )
     meter = AverageMeter()
     timer = StepTimer(warmup_steps=min(2, max(1, run.training_steps - 1)))
     n_chips = len(jax.devices())
@@ -340,13 +422,17 @@ def train(cfg: TrainConfig) -> dict:
                 last_metrics = summary
 
             if step % run.eval_interval == 0 or step == run.training_steps:
+                snap = _gather_data_cursor(cursor_log.get(step))
+                extra = {"data_cursor": snap} if snap is not None else None
+                for k in [k for k in cursor_log if k <= step]:
+                    del cursor_log[k]
                 if valid_factory is not None:
                     val = evaluate(eval_step, state, valid_factory(), pad_batch)
                     logger.log(val, step=step)
                     last_metrics |= val
-                    ckpt.save(step, state, metrics=val)
+                    ckpt.save(step, state, metrics=val, extra=extra)
                 else:
-                    ckpt.save(step, state)
+                    ckpt.save(step, state, extra=extra)
 
     ckpt.wait()
     ckpt.close()

@@ -1,5 +1,6 @@
 from jumbo_mae_tpu_tpu.data.loader import (
     DataConfig,
+    StreamCursor,
     TrainLoader,
     batch_train_samples,
     batch_valid_samples,
@@ -19,6 +20,7 @@ from jumbo_mae_tpu_tpu.data.tario import (
 
 __all__ = [
     "DataConfig",
+    "StreamCursor",
     "TrainLoader",
     "batch_train_samples",
     "batch_valid_samples",

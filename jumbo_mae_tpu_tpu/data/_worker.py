@@ -31,22 +31,29 @@ import sys
 def _run(cfg_json: str) -> None:
     from jumbo_mae_tpu_tpu.data.loader import (
         DataConfig,
+        StreamCursor,
         batch_train_samples,
         train_sample_stream,
     )
 
     spec = json.loads(cfg_json)
     cfg = DataConfig(**spec["data"])
+    start_epoch = spec.get("start_epoch", 0)
+    cursor = StreamCursor(start_epoch, spec.get("skip_samples", 0))
     stream = train_sample_stream(
         cfg,
         process_index=spec["process_index"],
         process_count=spec["process_count"],
         worker_index=spec["worker_index"],
         worker_count=spec["worker_count"],
-        start_epoch=spec.get("start_epoch", 0),
+        start_epoch=start_epoch,
+        skip_samples=spec.get("skip_samples", 0),
+        cursor=cursor,
     )
     out = sys.stdout.buffer
-    for batch in batch_train_samples(stream, spec["batch_size"], cfg.repeats):
+    for batch in batch_train_samples(
+        stream, spec["batch_size"], cfg.repeats, cursor=cursor
+    ):
         payload = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
         out.write(struct.pack(">Q", len(payload)))
         out.write(payload)

@@ -75,6 +75,32 @@ class DataConfig:
     decode_threads: int = 4
 
 
+@dataclass
+class StreamCursor:
+    """Mutable position of a train sample stream: ``offset`` samples (clones
+    included) have been yielded within ``epoch``. Updated in place by the
+    stream generators after every yield, so whoever drains the stream can
+    snapshot an exact resume point (sample-exact resume — beyond the
+    reference, whose restart lost the data position entirely,
+    ``/root/reference/src/utils.py:55-63``)."""
+
+    epoch: int = 0
+    offset: int = 0
+
+
+def _aug_rng(
+    seed: int, process_index: int, worker_index: int, epoch: int, idx: int
+) -> np.random.Generator:
+    """Per-sample augmentation RNG, derived independently of the shuffle RNG.
+
+    Keying augmentation on the yielded-sample index (instead of sharing the
+    epoch stream's generator) is what makes fast-skip possible: a resumed
+    stream can skip the transform compute for already-consumed samples
+    without perturbing any RNG state the remaining samples depend on.
+    """
+    return np.random.default_rng((seed, 3, process_index, worker_index, epoch, idx))
+
+
 class TrainTransform:
     """Per-sample train augmentation chain (crop → flip → policy → jitter →
     erasing), reproducing ``create_transforms`` train branch
@@ -133,11 +159,21 @@ def train_sample_stream(
     worker_index: int = 0,
     worker_count: int = 1,
     start_epoch: int = 0,
+    skip_samples: int = 0,
+    cursor: StreamCursor | None = None,
 ) -> Iterator[tuple[np.ndarray, int]]:
-    """Infinite (image, label) stream for one (process, worker) pair."""
+    """Infinite (image, label) stream for one (process, worker) pair.
+
+    ``skip_samples`` fast-forwards past already-consumed samples of the
+    starting epoch: shard order, shuffle-buffer draws, and decode all replay
+    (they define WHICH samples come next) but the augmentation transform —
+    the expensive part — is skipped, and per-sample RNG keying keeps the
+    remaining stream bit-identical to an uninterrupted one.
+    """
     shards = expand_shards(cfg.train_shards)
     transform = TrainTransform(cfg)
     epoch = start_epoch
+    to_skip = max(0, skip_samples)
     while True:
         rng = np.random.default_rng(
             (cfg.seed, 1, process_index, worker_index, epoch)
@@ -161,9 +197,19 @@ def train_sample_stream(
                 label = decode_label(sample["cls"]) if "cls" in sample else -1
                 yield img, label
 
+        idx = 0
         for img, label in _shuffle_stream(decoded(), cfg.shuffle_buffer, rng):
             for _ in range(cfg.repeats):
-                yield transform(rng, img), label
+                if to_skip > 0:
+                    to_skip -= 1
+                    idx += 1
+                    continue
+                aug = _aug_rng(cfg.seed, process_index, worker_index, epoch, idx)
+                out = transform(aug, img), label
+                idx += 1
+                if cursor is not None:
+                    cursor.epoch, cursor.offset = epoch, idx
+                yield out
         epoch += 1
 
 
@@ -199,7 +245,10 @@ def native_train_stream(
     within one process where the pure-Python path needs worker processes).
 
     One epoch of the process's shard stripe per native reader; shard order is
-    reshuffled per epoch like :func:`train_sample_stream`.
+    reshuffled per epoch like :func:`train_sample_stream`. NOT sample-exactly
+    resumable: the C++ reader threads interleave shards in run-dependent
+    order, so a skipped prefix would not be the consumed prefix — resume on
+    this path is epoch-granular only (``start_epoch``).
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -244,11 +293,14 @@ def native_train_stream(
             with NativeShardReader(
                 epoch_shards, threads=cfg.native_io_threads, loop=False
             ) as reader:
+                idx = 0
                 for img, label in _shuffle_stream(
                     decoded(reader), cfg.shuffle_buffer, rng
                 ):
                     for _ in range(cfg.repeats):
-                        yield transform(rng, img), label
+                        aug = _aug_rng(cfg.seed, process_index, 0, epoch, idx)
+                        idx += 1
+                        yield transform(aug, img), label
             epoch += 1
 
 
@@ -262,15 +314,24 @@ def _deinterleave(indices: int, repeats: int) -> np.ndarray:
 
 
 def batch_train_samples(
-    stream: Iterator[tuple[np.ndarray, int]], batch_size: int, repeats: int = 1
+    stream: Iterator[tuple[np.ndarray, int]],
+    batch_size: int,
+    repeats: int = 1,
+    cursor: StreamCursor | None = None,
 ) -> Iterator[dict[str, np.ndarray]]:
-    """Assemble train batches; de-interleave repeat clones."""
+    """Assemble train batches; de-interleave repeat clones. With ``cursor``
+    (the SAME object the stream updates), each batch carries a ``_cursor``
+    key — the (epoch, offset) reached after its last sample — so consumers
+    can checkpoint a sample-exact resume point."""
     order = _deinterleave(batch_size, max(1, repeats))
     while True:
         pairs = [next(stream) for _ in range(batch_size)]
         images = np.stack([p[0] for p in pairs])[order]
         labels = np.asarray([p[1] for p in pairs], np.int32)[order]
-        yield {"images": images, "labels": labels}
+        batch = {"images": images, "labels": labels}
+        if cursor is not None:
+            batch["_cursor"] = (cursor.epoch, cursor.offset)
+        yield batch
 
 
 def batch_valid_samples(
@@ -366,9 +427,15 @@ class TrainLoader:
 
     Each worker owns a disjoint shard stripe and yields WHOLE per-process
     batches (the torch IterableDataset-per-worker batching the reference
-    inherited); the parent round-robins worker queues, skipping dead workers
-    and raising only when none are left. ``workers=0`` runs inline — the
-    mode tests and CPU smoke configs use.
+    inherited); the parent consumes worker queues in STRICT round-robin order
+    — batch n always comes from worker ``n % workers`` — so the global batch
+    sequence is a pure function of the config, which is what makes
+    sample-exact resume possible. ``workers=0`` runs inline — the mode tests
+    and CPU smoke configs use.
+
+    ``snapshot()`` returns a JSON-able cursor (per-worker stream positions +
+    the round-robin phase); constructing a loader with ``cursor=`` resumes
+    the exact batch sequence from that point.
     """
 
     def __init__(
@@ -379,6 +446,7 @@ class TrainLoader:
         process_index: int = 0,
         process_count: int = 1,
         start_epoch: int = 0,
+        cursor: dict | None = None,
     ):
         if batch_size % max(1, cfg.repeats):
             raise ValueError(
@@ -389,22 +457,49 @@ class TrainLoader:
         self.batch_size = batch_size
         self._workers: list[_Worker] = []
         if cfg.use_native:
-            stream = native_train_stream(
+            if cursor is not None:
+                raise ValueError(
+                    "native-IO streams interleave shards in thread-dependent "
+                    "order and are not sample-exactly resumable — resume "
+                    "with the epoch cursor (start_epoch) instead"
+                )
+            self._cursors: list[tuple[int, int]] = []
+            self.batches_yielded = 0
+            self._stream = native_train_stream(
                 cfg,
                 process_index=process_index,
                 process_count=process_count,
                 start_epoch=start_epoch,
             )
-            self._inline = batch_train_samples(stream, batch_size, cfg.repeats)
+            self._inline = batch_train_samples(self._stream, batch_size, cfg.repeats)
             return
+        n_streams = 1 if cfg.workers <= 0 else cfg.workers
+        if cursor is not None:
+            starts = [tuple(c) for c in cursor["workers"]]
+            if len(starts) != n_streams:
+                raise ValueError(
+                    f"resume cursor has {len(starts)} worker streams but the "
+                    f"loader is configured for {n_streams} — restart with the "
+                    f"checkpointed worker count or fall back to epoch resume"
+                )
+            self.batches_yielded = int(cursor["batches"])
+        else:
+            starts = [(start_epoch, 0)] * n_streams
+            self.batches_yielded = 0
+        self._cursors = list(starts)
         if cfg.workers <= 0:
-            stream = train_sample_stream(
+            track = StreamCursor(*starts[0])
+            self._stream = train_sample_stream(
                 cfg,
                 process_index=process_index,
                 process_count=process_count,
-                start_epoch=start_epoch,
+                start_epoch=starts[0][0],
+                skip_samples=starts[0][1],
+                cursor=track,
             )
-            self._inline = batch_train_samples(stream, batch_size, cfg.repeats)
+            self._inline = batch_train_samples(
+                self._stream, batch_size, cfg.repeats, cursor=track
+            )
             return
         self._inline = None
         from dataclasses import asdict
@@ -418,37 +513,69 @@ class TrainLoader:
                 "process_count": process_count,
                 "worker_index": w,
                 "worker_count": cfg.workers,
-                "start_epoch": start_epoch,
+                "start_epoch": starts[w][0],
+                "skip_samples": starts[w][1],
             }
             self._workers.append(_Worker(spec, per_worker_q))
-        self._next_worker = 0
+
+    def snapshot(self) -> dict | None:
+        """Resume cursor as of the last batch returned by ``__next__``, or
+        ``None`` when the substrate can't support sample-exact resume
+        (native-IO: thread-interleaved shard order)."""
+        if not self._cursors:
+            return None
+        return {
+            "workers": [list(c) for c in self._cursors],
+            "batches": self.batches_yielded,
+        }
 
     def __iter__(self):
         return self
 
     def __next__(self) -> dict[str, np.ndarray]:
         if self._inline is not None:
-            return next(self._inline)
-        attempts_left = 120  # x 5s = 10 min of silence before giving up
-        while True:
-            live = [w for w in self._workers if not (w.dead and w.queue.empty())]
-            if not live:
-                raise RuntimeError("all data workers died")
-            w = live[self._next_worker % len(live)]
-            self._next_worker += 1
-            try:
-                return w.queue.get(timeout=5)
-            except queue_mod.Empty:
-                attempts_left -= 1
-                if attempts_left <= 0:
+            batch = next(self._inline)
+            slot = 0
+        else:
+            slot = self.batches_yielded % len(self._workers)
+            w = self._workers[slot]
+            attempts_left = 120  # x 5s = 10 min of silence before giving up
+            while True:
+                if w.dead and w.queue.empty():
+                    # skipping a dead worker would silently fork the batch
+                    # sequence away from the deterministic schedule
                     raise RuntimeError(
-                        "data workers alive but produced nothing for 10 minutes"
-                    ) from None
+                        f"data worker {slot} died; deterministic stream lost"
+                    )
+                try:
+                    batch = w.queue.get(timeout=5)
+                    break
+                except queue_mod.Empty:
+                    attempts_left -= 1
+                    if attempts_left <= 0:
+                        raise RuntimeError(
+                            f"data worker {slot} alive but produced nothing "
+                            "for 10 minutes"
+                        ) from None
+        cur = batch.pop("_cursor", None)
+        if cur is not None:
+            self._cursors[slot] = (int(cur[0]), int(cur[1]))
+        self.batches_yielded += 1
+        return batch
 
     def close(self):
         for w in self._workers:
             w.stop()
         self._workers.clear()
+        # close inline generators now (innermost first) so stream resources
+        # (native reader threads, decode pools) unwind while the interpreter
+        # is still fully alive, not at GC-at-exit time
+        if getattr(self, "_inline", None) is not None:
+            self._inline.close()
+            self._inline = None
+        if getattr(self, "_stream", None) is not None:
+            self._stream.close()
+            self._stream = None
 
     def __del__(self):  # pragma: no cover
         try:
