@@ -73,6 +73,12 @@ class DataConfig:
     use_native: bool = False
     native_io_threads: int = 4
     decode_threads: int = 4
+    # directory for the on-disk validation-sample cache (data/valcache.py):
+    # the first eval pass writes post-transform tensors there, every later
+    # eval streams from the cache with zero shard reads/decodes (parity+:
+    # the reference cached the raw val tars, /root/reference/src/dataset.py:141).
+    # Empty string disables caching.
+    valid_cache: str = ""
 
 
 @dataclass
@@ -591,10 +597,35 @@ def valid_loader(
     process_index: int = 0,
     process_count: int = 1,
 ) -> Iterator[dict[str, np.ndarray]]:
-    """Fresh sequential eval iterator (construct per evaluation)."""
-    stream = valid_sample_stream(
-        cfg, process_index=process_index, process_count=process_count
-    )
+    """Fresh sequential eval iterator (construct per evaluation). With
+    ``cfg.valid_cache`` set, the first pass populates the on-disk sample
+    cache and every later pass streams from it without touching the shards."""
+    if cfg.valid_cache:
+        from jumbo_mae_tpu_tpu.data.valcache import ValidSampleCache
+
+        cache = ValidSampleCache(
+            cfg.valid_cache,
+            key_fields={
+                "shards": expand_shards(cfg.valid_shards),
+                "image_size": cfg.image_size,
+                "test_crop_ratio": cfg.test_crop_ratio,
+                "process_index": process_index,
+                "process_count": process_count,
+            },
+            image_size=cfg.image_size,
+        )
+        if cache.complete():
+            stream = cache.read()
+        else:
+            stream = cache.capture(
+                valid_sample_stream(
+                    cfg, process_index=process_index, process_count=process_count
+                )
+            )
+    else:
+        stream = valid_sample_stream(
+            cfg, process_index=process_index, process_count=process_count
+        )
     return batch_valid_samples(stream, batch_size, cfg.image_size)
 
 
