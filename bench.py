@@ -69,6 +69,7 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
             weight_decay=0.05,
             warmup_steps=100,
             training_steps=10_000,
+            mu_dtype=os.environ.get("BENCH_MU_DTYPE") or None,
         ),
         global_batch_size=batch_size,
     )

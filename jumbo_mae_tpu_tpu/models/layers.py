@@ -5,8 +5,10 @@ Fresh flax.linen implementations with behavioral parity to
 FeedForward, ViTLayer, JumboLayer, LinearCLS), designed TPU-first:
 
 - compute in a configurable dtype (bfloat16 by default) with float32 params;
-- attention logits accumulated and softmaxed in float32
-  (``preferred_element_type``) before casting back — numerically safe on MXU;
+- attention scores accumulate in float32 on the MXU and softmax computes in
+  float32, but the materialized score/prob tensors follow the compute dtype
+  (halves the O(S²) HBM traffic under bf16; exact under f32 compute, which
+  is what every parity test runs — see PERF.md);
 - attention implementation switchable between a fused Pallas flash kernel and
   the plain einsum path (the einsum path is also the parity oracle in tests).
 
@@ -77,6 +79,9 @@ class Attention(nn.Module):
                 "dropout; set dropout=0.0 to train (droppath regularization "
                 "still applies)"
             )
+        # z_head_major tracks each branch's output layout: (B,H,S,D) for the
+        # einsum path, (B,S,H,D) for flash/ring — set alongside z so a new
+        # branch can't silently mismatch the out-projection's axes.
         if cfg.attn_impl == "ring":
             # Sequence parallelism: tokens shard over the ambient mesh's
             # "seq" axis, K/V ring-rotate over ICI (parallel/ring_attention).
@@ -84,22 +89,36 @@ class Attention(nn.Module):
                 ring_self_attention,
             )
 
-            z = ring_self_attention(q, k, v)
+            z, z_head_major = ring_self_attention(q, k, v), False
         elif cfg.attn_impl == "flash":
             from jumbo_mae_tpu_tpu.ops.flash_attention import flash_attention
 
-            z = flash_attention(q, k, v)
+            z, z_head_major = flash_attention(q, k, v), False
         else:
-            logits = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+            # Scores materialize in the compute dtype; the MXU still
+            # accumulates the dot in f32, and softmax still computes in f32
+            # (the convert fuses into the softmax chain). Under bf16 compute
+            # this halves the HBM traffic of the O(S²) score tensor — the
+            # single largest bandwidth item in the profile: −27 ms/step on
+            # the v5e bench workload's 8 decoder layers (PERF.md). Only the
+            # materialized rounding is bf16; with float32 compute (all
+            # parity tests/oracles) the path is exact and unchanged.
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+                cfg.compute_dtype
             )
-            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.compute_dtype)
             probs = nn.Dropout(cfg.dropout)(probs, deterministic)
-            z = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            # Keep z head-major (B,H,S,D) — the layout the scores matmul
+            # produces natively — and let the output projection contract
+            # (h, d) from there: measured −17% attention fwd+bwd on v5e at
+            # the encoder shape vs transposing back to (B,S,H,D) (PERF.md).
+            z, z_head_major = jnp.einsum("bhqk,bkhd->bhqd", probs, v), True
 
+        # kernel shape is (heads, head_dim, dim) for either axis choice, so
+        # both paths share the same checkpoint layout
         out = nn.DenseGeneral(
             cfg.dim,
-            axis=(-2, -1),
+            axis=(1, 3) if z_head_major else (-2, -1),
             kernel_init=TRUNC_NORMAL,
             dtype=cfg.compute_dtype,
             name="out",

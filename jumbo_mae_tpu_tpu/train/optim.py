@@ -45,6 +45,10 @@ class OptimConfig:
     training_steps: int = 1
     init_lr: float = 1e-6
     end_lr: float = 1e-5
+    # dtype for the Adam first moment (optax mu_dtype). "bfloat16" halves the
+    # first-moment HBM traffic in the (bandwidth-bound) optimizer update; the
+    # second moment and params stay float32.
+    mu_dtype: str | None = None
 
     def peak_lr(self, global_batch_size: int) -> float:
         if self.lr_scaling == "batch":
@@ -85,11 +89,11 @@ def make_schedule(cfg: OptimConfig, global_batch_size: int) -> optax.Schedule:
 
 
 def modified_lamb(
-    learning_rate, b1, b2, eps, weight_decay, mask
+    learning_rate, b1, b2, eps, weight_decay, mask, mu_dtype=None
 ) -> optax.GradientTransformation:
     """LAMB with the trust ratio restricted to weight-decayed params."""
     return optax.chain(
-        optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+        optax.scale_by_adam(b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype),
         optax.add_decayed_weights(weight_decay=weight_decay, mask=mask),
         optax.masked(optax.scale_by_trust_ratio(), mask=mask),
         optax.scale_by_learning_rate(learning_rate),
@@ -116,10 +120,17 @@ def make_optimizer(
                 eps=cfg.eps,
                 weight_decay=cfg.weight_decay,
                 mask=wd_mask,
+                mu_dtype=cfg.mu_dtype,
             )
         elif cfg.name == "lamb":
             tx = modified_lamb(
-                learning_rate, cfg.b1, cfg.b2, cfg.eps, cfg.weight_decay, wd_mask
+                learning_rate,
+                cfg.b1,
+                cfg.b2,
+                cfg.eps,
+                cfg.weight_decay,
+                wd_mask,
+                mu_dtype=cfg.mu_dtype,
             )
         elif cfg.name == "lars":
             tx = optax.lars(learning_rate, momentum=cfg.momentum)
