@@ -18,6 +18,41 @@ Posemb = Literal["learnable", "sincos2d"]
 Pooling = Literal["cls", "gap"]
 AttnImpl = Literal["einsum", "flash", "ring", "auto"]
 MaskModeT = Literal["shared", "per_sample"]
+# rematerialization policy under grad_ckpt=True:
+#   "none"          — save nothing, recompute the whole block (max memory win)
+#   "dots"          — save every matmul output, recompute elementwise only
+#   "dots_no_batch" — save param matmuls but not attention score matmuls
+RematPolicy = Literal["none", "dots", "dots_no_batch"]
+
+
+def checkpoint_policy(name: str):
+    """Map a RematPolicy name to the jax.checkpoint policy callable (None =
+    nothing saveable, jax.checkpoint's default)."""
+    import jax
+
+    if name == "none":
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    if name == "dots_no_batch":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat policy {name!r}")
+
+
+def maybe_remat(block_cls, cfg):
+    """Wrap a transformer block class with ``nn.remat`` per the config's
+    ``grad_ckpt``/``remat_policy`` knobs (the one place the remat wiring
+    lives; used by both the encoder and the MAE decoder). The deterministic
+    flag (arg 2) stays static."""
+    import flax.linen as nn
+
+    if not cfg.grad_ckpt:
+        return block_cls
+    return nn.remat(
+        block_cls,
+        static_argnums=(2,),
+        policy=checkpoint_policy(cfg.remat_policy),
+    )
 
 
 @dataclass(frozen=True)
@@ -44,6 +79,7 @@ class JumboViTConfig:
     dropout: float = 0.0
     droppath: float = 0.0
     grad_ckpt: bool = False
+    remat_policy: RematPolicy = "none"
 
     # MAE
     mask_ratio: float | None = None
@@ -104,6 +140,7 @@ class DecoderConfig:
     dropout: float = 0.0
     droppath: float = 0.0
     grad_ckpt: bool = False
+    remat_policy: RematPolicy = "none"
 
     dtype: str = "bfloat16"
     attn_impl: AttnImpl = "auto"

@@ -19,7 +19,11 @@ import jax
 import jax.numpy as jnp
 from flax.linen import initializers as init
 
-from jumbo_mae_tpu_tpu.models.config import DecoderConfig, JumboViTConfig
+from jumbo_mae_tpu_tpu.models.config import (
+    DecoderConfig,
+    JumboViTConfig,
+    maybe_remat,
+)
 from jumbo_mae_tpu_tpu.models.layers import TRUNC_NORMAL, PlainBlock
 from jumbo_mae_tpu_tpu.models.vit import JumboViT
 from jumbo_mae_tpu_tpu.ops.masking import unshuffle_with_mask_tokens
@@ -52,9 +56,7 @@ class MAEDecoder(nn.Module):
         x = jnp.concatenate(
             [x[:, :k, :], x[:, k:, :] + jnp.asarray(pos, x.dtype)], axis=1
         )
-        block_cls = (
-            nn.remat(PlainBlock, static_argnums=(2,)) if cfg.grad_ckpt else PlainBlock
-        )
+        block_cls = maybe_remat(PlainBlock, cfg)
         for i in range(cfg.layers):
             x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
         return nn.LayerNorm(dtype=cfg.compute_dtype, name="ln")(x)

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from flax.linen import initializers as init
 
-from jumbo_mae_tpu_tpu.models.config import JumboViTConfig
+from jumbo_mae_tpu_tpu.models.config import JumboViTConfig, maybe_remat
 from jumbo_mae_tpu_tpu.models.layers import (
     ClassifierHead,
     JumboBlock,
@@ -53,9 +53,7 @@ class JumboViT(nn.Module):
             dtype=cfg.compute_dtype,
             name="jumbo_mlp",
         )
-        block_cls = (
-            nn.remat(JumboBlock, static_argnums=(2,)) if cfg.grad_ckpt else JumboBlock
-        )
+        block_cls = maybe_remat(JumboBlock, cfg)
         self.blocks = [
             block_cls(cfg, self.jumbo_mlp, name=f"block_{i}")
             for i in range(cfg.layers)
