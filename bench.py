@@ -58,7 +58,10 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
         labels=None,
         posemb="sincos2d",
         dtype=dtype,
-        grad_ckpt=spec["remat"],
+        # an explicit BENCH_REMAT_POLICY also turns remat ON for models that
+        # default to remat=False — otherwise the override would silently
+        # no-op (maybe_remat ignores the policy when grad_ckpt is false)
+        grad_ckpt=spec["remat"] or bool(os.environ.get("BENCH_REMAT_POLICY")),
         remat_policy=os.environ.get(
             "BENCH_REMAT_POLICY", spec.get("remat_policy", "none")
         ),
@@ -93,11 +96,14 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
     batch = jax.device_put(batch, batch_sharding(mesh))
 
     # analytic step FLOPs → the 100%-MFU step-time floor for the timing
-    # plausibility guard (a real measurement can never beat the chip's peak)
+    # plausibility guard (a real measurement can never beat the chip's peak).
+    # Unknown accelerators disable the guard (floor 0) rather than inherit a
+    # fallback peak that a faster chip could legitimately beat.
     from jumbo_mae_tpu_tpu.utils.mfu import detect_peak_tflops, pretrain_flops_per_image
 
+    peak = detect_peak_tflops(default=0.0)
     flops_per_step = pretrain_flops_per_image(enc, dec) * batch_size
-    floor_ms = flops_per_step / (detect_peak_tflops() * 1e12) * 1e3
+    floor_ms = 0.0 if peak <= 0 else flops_per_step / (peak * 1e12) * 1e3
     return step, state, batch, floor_ms
 
 
