@@ -1,5 +1,11 @@
 from jumbo_mae_tpu_tpu.ops.masking import (
+    all_mask,
     index_sequence,
+    mask_intersection,
+    mask_not,
+    mask_select,
+    mask_union,
+    no_mask,
     random_masking,
     unshuffle_with_mask_tokens,
 )
@@ -12,7 +18,13 @@ from jumbo_mae_tpu_tpu.ops.patches import (
 from jumbo_mae_tpu_tpu.ops.posemb import sincos2d_positional_embedding
 
 __all__ = [
+    "all_mask",
     "index_sequence",
+    "mask_intersection",
+    "mask_not",
+    "mask_select",
+    "mask_union",
+    "no_mask",
     "random_masking",
     "unshuffle_with_mask_tokens",
     "extract_patches",

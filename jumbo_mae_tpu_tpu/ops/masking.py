@@ -88,6 +88,57 @@ def random_masking(
     raise ValueError(f"unknown masking mode: {mode!r}")
 
 
+# --------------------------------------------------------------------------
+# Mask algebra (parity: ``/root/reference/src/utils_mae.py:24-49``). Masks are
+# float arrays with 1.0 at MASKED positions. The reference fork never calls
+# these itself (they come from its m3ae ancestry), but they complete the
+# utils_mae surface for users combining masks — e.g. masking the union of an
+# MAE mask and a padding mask.
+# --------------------------------------------------------------------------
+
+
+def no_mask(x: jax.Array) -> jax.Array:
+    """All-zeros (nothing masked) mask for a (batch, len, ...) sequence."""
+    return jnp.zeros(x.shape[:2], dtype=jnp.float32)
+
+
+def all_mask(x: jax.Array) -> jax.Array:
+    """All-ones (everything masked) mask for a (batch, len, ...) sequence."""
+    return jnp.ones(x.shape[:2], dtype=jnp.float32)
+
+
+def mask_not(mask: jax.Array) -> jax.Array:
+    return 1.0 - (mask > 0).astype(jnp.float32)
+
+
+def mask_union(*masks: jax.Array) -> jax.Array:
+    """Positions masked (>0) in ANY input mask; output is binary 0/1 like the
+    reference's helpers, so soft/weighted inputs collapse rather than
+    propagate."""
+    out = (masks[0] > 0)
+    for m in masks[1:]:
+        out = out | (m > 0)
+    return out.astype(jnp.float32)
+
+
+def mask_intersection(*masks: jax.Array) -> jax.Array:
+    """Positions masked (>0) in EVERY input mask; binary 0/1 output."""
+    out = (masks[0] > 0)
+    for m in masks[1:]:
+        out = out & (m > 0)
+    return out.astype(jnp.float32)
+
+
+def mask_select(
+    mask: jax.Array, when_unmasked: jax.Array, when_masked: jax.Array
+) -> jax.Array:
+    """Elementwise choose ``when_unmasked`` where mask==0 else
+    ``when_masked`` — the reference's argument order (second argument is the
+    UNMASKED value). The mask broadcasts over trailing feature axes."""
+    m = mask.reshape(mask.shape + (1,) * (when_unmasked.ndim - mask.ndim))
+    return jnp.where(m > 0, when_masked, when_unmasked)
+
+
 def unshuffle_with_mask_tokens(
     visible: jax.Array,
     mask_token: jax.Array,

@@ -60,7 +60,11 @@ def ring_attention(
 
     def hop(carry, _):
         m, l, acc, k_cur, v_cur, bias = carry
-        # issue the rotation FIRST so the transfer overlaps this block's math
+        # issue the rotation FIRST so the compiler MAY overlap the transfer
+        # with this block's math (standard ring-attention scheduling; actual
+        # ICI/compute overlap is up to XLA's scheduler and has not been
+        # profiled on multi-chip hardware — this sandbox has one chip, so
+        # only the numerics/gradients of the ring are verified here)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         bias_nxt = (

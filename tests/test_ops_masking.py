@@ -81,3 +81,56 @@ def test_index_sequence_1d_and_2d():
     ids2 = jnp.array([[1, 3], [0, 2]])
     out2 = index_sequence(x, ids2)
     np.testing.assert_array_equal(np.asarray(out2[1, 1]), np.asarray(x[1, 2]))
+
+
+def test_mask_algebra():
+    """Parity: the m3ae mask helpers (/root/reference/src/utils_mae.py:24-49)."""
+    from jumbo_mae_tpu_tpu.ops import (
+        all_mask,
+        mask_intersection,
+        mask_not,
+        mask_select,
+        mask_union,
+        no_mask,
+    )
+
+    x = jnp.zeros((2, 5, 3))
+    z, o = no_mask(x), all_mask(x)
+    np.testing.assert_array_equal(np.asarray(z), np.zeros((2, 5)))
+    np.testing.assert_array_equal(np.asarray(o), np.ones((2, 5)))
+
+    a = jnp.array([[0.0, 1.0, 0.0, 1.0, 0.0]])
+    b = jnp.array([[0.0, 0.0, 1.0, 1.0, 0.0]])
+    np.testing.assert_array_equal(
+        np.asarray(mask_union(a, b)), [[0, 1, 1, 1, 0]]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(mask_intersection(a, b)), [[0, 0, 0, 1, 0]]
+    )
+    np.testing.assert_array_equal(np.asarray(mask_not(a)), [[1, 0, 1, 0, 1]])
+    # de Morgan: not(a ∪ b) == not(a) ∩ not(b)
+    np.testing.assert_array_equal(
+        np.asarray(mask_not(mask_union(a, b))),
+        np.asarray(mask_intersection(mask_not(a), mask_not(b))),
+    )
+
+    # reference argument order: second arg is the UNMASKED value
+    when_unmasked = jnp.zeros((1, 5, 2))
+    when_masked = jnp.full((1, 5, 2), 9.0)
+    sel = mask_select(a, when_unmasked, when_masked)
+    np.testing.assert_array_equal(np.asarray(sel[0, :, 0]), [0, 9, 0, 9, 0])
+
+    # soft/weighted masks binarize like the reference ((>0) semantics)
+    np.testing.assert_array_equal(
+        np.asarray(mask_union(jnp.array([[0.3, 0.0]]), jnp.array([[0.2, 0.0]]))),
+        [[1.0, 0.0]],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(
+            mask_intersection(jnp.array([[2.0, 0.5]]), jnp.array([[0.5, 0.0]]))
+        ),
+        [[1.0, 0.0]],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(mask_not(jnp.array([[0.3, 0.0]]))), [[0.0, 1.0]]
+    )

@@ -338,6 +338,35 @@ class TestEvalStep:
         out2 = eval_step(state, batch)
         np.testing.assert_allclose(float(out["loss"]), float(out2["loss"]))
 
+    def test_pretrain_eval_stream_pinned(self):
+        """Consecutive evals of an UNCHANGED model must report identical
+        val/loss — the eval mask RNG is a pure function of (state.rng,
+        state.step, batch index), with no hidden counter (VERDICT weak #8:
+        the reference's det=False eval re-drew masks every pass). A
+        different batch index must still draw a different mask."""
+        module = pretrain_module()
+        mesh, state, sharding, _ = build(
+            MeshConfig(data=1, fsdp=1), module, "pretrain", batch=batch_of(8)
+        )
+        eval_step = make_eval_step(mesh, sharding, mode="pretrain")
+        batches = [batch_of(8, seed=s) for s in range(3)]
+
+        def run_eval():
+            total = n = 0.0
+            for i, b in enumerate(batches):
+                out = eval_step(state, b, i)
+                total += float(out["loss"])
+                n += float(out["num_samples"])
+            return total / n
+
+        first, second = run_eval(), run_eval()
+        assert first == second  # bitwise: same program, same inputs
+
+        # the per-batch mask stream varies: same data, different batch_idx
+        a = float(eval_step(state, batches[0], 0)["loss"])
+        b = float(eval_step(state, batches[0], 1)["loss"])
+        assert a != b
+
 
 class TestOptim:
     def test_schedule_warmup_peak_end(self):
