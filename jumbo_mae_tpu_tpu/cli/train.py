@@ -58,6 +58,7 @@ from jumbo_mae_tpu_tpu.utils import (
     StepTimer,
     classify_flops_per_image,
     mfu_report,
+    param_summary,
     pretrain_flops_per_image,
 )
 from jumbo_mae_tpu_tpu.utils.profiling import trace
@@ -240,6 +241,57 @@ def make_valid_iterator(cfg: TrainConfig, mesh, per_process: int):
     )
 
 
+class PreemptionGuard:
+    """SIGTERM-safe training: TPU pods get preempted with a grace window, so
+    a termination signal flips a flag and the step loop checkpoints at the
+    next step boundary instead of dying mid-state (the reference had no
+    resume at all, let alone a graceful-preemption path). SIGINT gets the
+    same treatment so ^C on an interactive run saves before exiting."""
+
+    def __init__(self):
+        self.flagged = False
+
+    def install(self) -> bool:
+        import signal
+
+        def handler(signum, frame):
+            if self.flagged:
+                # second signal: restore default behavior so a stuck run
+                # (hung collective, long compile) stays force-killable
+                signal.signal(signum, signal.SIG_DFL)
+                signal.raise_signal(signum)
+                return
+            self.flagged = True
+            print(
+                f"[train] caught signal {signum}: will checkpoint and exit "
+                "at the next step boundary (signal again to force-exit)"
+            )
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, handler)
+            except ValueError:  # not the main thread (e.g. under a runner)
+                print(
+                    "[train] WARNING: not on the main thread — graceful "
+                    "preemption disabled; SIGTERM will kill the run "
+                    "without a checkpoint"
+                )
+                return False
+        return True
+
+
+def _agree_on_preemption(preempt: "PreemptionGuard", process_count: int) -> bool:
+    """Whether to take the preemption exit — all processes must agree (a
+    checkpoint save is collective), so multi-host gathers every host's flag."""
+    if process_count == 1:
+        return preempt.flagged
+    from jax.experimental import multihost_utils
+
+    return bool(
+        multihost_utils.process_allgather(np.asarray(preempt.flagged)).any()
+    )
+
+
 def _gather_data_cursor(snap: dict | None) -> dict | None:
     """Make a loader snapshot checkpoint-safe under multi-host: Orbax's JSON
     payload is host-0's, so every process's cursor is all-gathered into it
@@ -354,6 +406,12 @@ def train(cfg: TrainConfig) -> dict:
     eval_step = make_eval_step(mesh, state_sharding, mode=mode_key)
 
     is_main = jax.process_index() == 0
+    if is_main:
+        # startup parameter table (parity: the reference's module.tabulate
+        # pre-flight print, /root/reference/src/pretraining.py:214)
+        print(param_summary(state.params))
+    preempt = PreemptionGuard()
+    preempt.install()
     logger = MetricLogger(
         Path(run.output_dir) / run.name,
         name=run.name,
@@ -421,6 +479,7 @@ def train(cfg: TrainConfig) -> dict:
                 logger.log(summary, step=step)
                 last_metrics = summary
 
+            saved_this_step = False
             if step % run.eval_interval == 0 or step == run.training_steps:
                 snap = _gather_data_cursor(cursor_log.get(step))
                 extra = {"data_cursor": snap} if snap is not None else None
@@ -433,6 +492,27 @@ def train(cfg: TrainConfig) -> dict:
                     ckpt.save(step, state, metrics=val, extra=extra)
                 else:
                     ckpt.save(step, state, extra=extra)
+                saved_this_step = True
+
+            # Graceful preemption: single-host checks the flag every step;
+            # multi-host only at log/eval boundaries (reaching agreement
+            # needs a host allgather, which would serialize dispatch if done
+            # per step), which is well inside any preemption grace window.
+            boundary = (
+                process_count == 1
+                or saved_this_step
+                or step % run.log_interval == 0
+            )
+            if boundary and _agree_on_preemption(preempt, process_count):
+                if not saved_this_step:
+                    snap = _gather_data_cursor(cursor_log.get(step))
+                    ckpt.save(
+                        step,
+                        state,
+                        extra={"data_cursor": snap} if snap is not None else None,
+                    )
+                print(f"[train] preemption checkpoint at step {step}; exiting")
+                break
 
     ckpt.wait()
     ckpt.close()

@@ -9,6 +9,7 @@ from jumbo_mae_tpu_tpu.utils.mfu import (
     pretrain_flops_per_image,
 )
 from jumbo_mae_tpu_tpu.utils.profiling import annotate, trace
+from jumbo_mae_tpu_tpu.utils.summary import param_summary
 
 __all__ = [
     "AverageMeter",
@@ -20,6 +21,7 @@ __all__ = [
     "detect_peak_tflops",
     "encoder_flops_per_image",
     "mfu_report",
+    "param_summary",
     "pretrain_flops_per_image",
     "trace",
 ]

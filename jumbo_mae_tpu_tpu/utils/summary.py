@@ -1,0 +1,61 @@
+"""Parameter-count summary printed at startup.
+
+Parity: the reference printed ``module.tabulate(...)`` before training — a
+human-checked parameter/shape table that was its main pre-flight QA
+(``/root/reference/src/pretraining.py:214``, SURVEY §4). Flax's tabulate
+re-runs module init abstractly; here the state is already materialized
+(sharded init), so the summary walks the real param tree instead — no
+second trace, and the numbers describe exactly what will train.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _count(tree) -> tuple[int, int]:
+    """(param count, bytes) of a pytree of arrays."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    n = sum(int(np.prod(x.shape)) for x in leaves)
+    b = sum(int(np.prod(x.shape)) * x.dtype.itemsize for x in leaves)
+    return n, b
+
+
+def _fmt_count(n: int) -> str:
+    for unit, div in (("B", 1e9), ("M", 1e6), ("K", 1e3)):
+        if n >= div:
+            return f"{n / div:,.2f}{unit}"
+    return str(n)
+
+
+def param_summary(params, *, depth: int = 2) -> str:
+    """Render a per-subtree parameter table (down to ``depth`` path levels)
+    plus totals, e.g.::
+
+        encoder/block_0            12.60M
+        ...
+        total                     331.44M params (1.23 GiB)
+    """
+    from flax import serialization
+
+    sd = serialization.to_state_dict(params)
+    rows: list[tuple[str, int]] = []
+
+    def walk(node, path):
+        if not isinstance(node, dict) or len(path) >= depth:
+            rows.append(("/".join(path), _count(node)[0]))
+            return
+        for key in node:
+            walk(node[key], path + [key])
+
+    walk(sd, [])
+    total_n, total_b = _count(sd)
+    width = max((len(name) for name, _ in rows), default=10) + 2
+    lines = [f"{name:<{width}} {_fmt_count(n):>10}" for name, n in rows]
+    lines.append(
+        f"{'total':<{width}} {_fmt_count(total_n):>10} params "
+        f"({total_b / 2**30:.2f} GiB)"
+    )
+    return "\n".join(lines)

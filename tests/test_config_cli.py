@@ -185,6 +185,51 @@ def test_sample_exact_resume_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
+    """Graceful preemption: SIGTERM mid-run → the loop checkpoints at the
+    next step boundary and exits 0; the checkpoint resumes normally."""
+    import os
+    import signal
+    import subprocess
+    import sys as _sys
+    import time
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    cmd = [
+        _sys.executable, "-m", "jumbo_mae_tpu_tpu.cli.train",
+        "--config", str(RECIPES / "smoke_cpu.yaml"),
+        "--set", f"run.output_dir={tmp_path}", "run.training_steps=100000",
+        "run.eval_interval=100000", "run.log_interval=5",
+        "run.sanity_eval=false",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(repo))
+    proc = subprocess.Popen(
+        cmd, cwd=str(repo), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        metrics = tmp_path / "smoke_cpu" / "smoke_cpu-metrics.jsonl"
+        deadline = time.time() + 300
+        while time.time() < deadline and not metrics.exists():
+            if proc.poll() is not None:
+                raise AssertionError(f"train died early:\n{proc.stdout.read()}")
+            time.sleep(1)
+        assert metrics.exists(), "training never produced metrics"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:  # never orphan a 100000-step child
+            proc.kill()
+            proc.wait()
+    assert proc.returncode == 0, out
+    assert "preemption checkpoint" in out
+    last = tmp_path / "smoke_cpu" / "ckpt" / "last"
+    steps = [int(p.name) for p in last.iterdir() if p.name.isdigit()]
+    assert steps and max(steps) < 100000
+
+
+@pytest.mark.slow
 def test_smoke_finetune_resume(tmp_path):
     """Classify mode end-to-end + true resume continues the step counter."""
     from jumbo_mae_tpu_tpu.cli.train import train

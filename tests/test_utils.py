@@ -153,3 +153,22 @@ def test_step_timer():
     for _ in range(5):
         t.tick()
     assert t.steps_per_sec is not None and t.steps_per_sec > 0
+
+
+def test_param_summary():
+    """Startup parameter table (parity: the reference's module.tabulate
+    pre-flight print): per-subtree rows + an exact total."""
+    import numpy as np
+
+    from jumbo_mae_tpu_tpu.utils import param_summary
+
+    params = {
+        "encoder": {
+            "block_0": {"kernel": np.zeros((4, 8), np.float32)},
+            "block_1": {"kernel": np.zeros((4, 8), np.float32)},
+        },
+        "head": {"kernel": np.zeros((8, 10), np.float32), "bias": np.zeros(10)},
+    }
+    out = param_summary(params)
+    assert "encoder/block_0" in out and "head" in out
+    assert "total" in out and "154" in out  # 32 + 32 + 80 + 10
