@@ -159,26 +159,7 @@ def make_train_iterator(
         )
         source = None
     else:
-        # The checkpoint records every process's cursor plus the saving
-        # topology (the saved JSON is host-0's); sample-exact resume is only
-        # valid with the SAME process count — shard stripes and per-process
-        # batch sizes are topology-dependent — so any mismatch drops every
-        # process to epoch resume together (a mixed schedule would be
-        # globally inconsistent).
-        if data_cursor is not None:
-            saved_pc = int(data_cursor.get("process_count", 1))
-            if saved_pc != jax.process_count():
-                print(
-                    f"[train] WARNING: checkpoint data cursor was saved with "
-                    f"{saved_pc} processes but this run has "
-                    f"{jax.process_count()}; falling back to epoch resume"
-                )
-                data_cursor = None
-            elif "per_process" in data_cursor:
-                data_cursor = {
-                    "workers": data_cursor["per_process"][jax.process_index()],
-                    "batches": data_cursor["batches"],
-                }
+        data_cursor = _pick_process_cursor(data_cursor)
         loader_kwargs = dict(
             process_index=jax.process_index(),
             process_count=jax.process_count(),
@@ -290,6 +271,32 @@ def _agree_on_preemption(preempt: "PreemptionGuard", process_count: int) -> bool
     return bool(
         multihost_utils.process_allgather(np.asarray(preempt.flagged)).any()
     )
+
+
+def _pick_process_cursor(data_cursor: dict | None) -> dict | None:
+    """Restore-side counterpart of :func:`_gather_data_cursor`: select this
+    process's cursor from the checkpointed payload. The checkpoint records
+    every process's cursor plus the saving topology (the saved JSON is
+    host-0's); sample-exact resume is only valid with the SAME process count
+    — shard stripes and per-process batch sizes are topology-dependent — so
+    any mismatch drops every process to epoch resume together (a mixed
+    schedule would be globally inconsistent)."""
+    if data_cursor is None:
+        return None
+    saved_pc = int(data_cursor.get("process_count", 1))
+    if saved_pc != jax.process_count():
+        print(
+            f"[train] WARNING: checkpoint data cursor was saved with "
+            f"{saved_pc} processes but this run has "
+            f"{jax.process_count()}; falling back to epoch resume"
+        )
+        return None
+    if "per_process" in data_cursor:
+        return {
+            "workers": data_cursor["per_process"][jax.process_index()],
+            "batches": data_cursor["batches"],
+        }
+    return data_cursor
 
 
 def _gather_data_cursor(snap: dict | None) -> dict | None:

@@ -140,7 +140,31 @@ def run_leg(shards: str) -> dict:
         sharding,
     )
     val = evaluate(eval_step, state, batches, pad)
-    return {"losses": losses, "val": val}
+
+    # multi-host sample-exact-resume plumbing: every process contributes its
+    # own (distinct) cursor to the gathered checkpoint payload, and the REAL
+    # restore-side pick (_pick_process_cursor, the same function
+    # make_train_iterator calls) returns exactly this process's entry —
+    # while a topology mismatch drops to epoch resume
+    cursor = None
+    if n > 1:
+        from jumbo_mae_tpu_tpu.cli.train import (
+            _gather_data_cursor,
+            _pick_process_cursor,
+        )
+
+        gathered = _gather_data_cursor({"workers": [[pid, 10 + pid]], "batches": 5})
+        cursor = {
+            "process_count": gathered["process_count"],
+            "batches": gathered["batches"],
+            "mine": _pick_process_cursor(gathered)["workers"],
+            "all": gathered["per_process"],
+            "mismatch_dropped": _pick_process_cursor(
+                dict(gathered, process_count=n + 1)
+            )
+            is None,
+        }
+    return {"losses": losses, "val": val, "cursor": cursor}
 
 
 def main():

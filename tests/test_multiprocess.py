@@ -127,6 +127,15 @@ def test_two_process_train_and_eval_match_single_process(shards, tmp_path):
         rtol=1e-6,
     )
 
+    # multi-host cursor gather: host-0's saved payload carries BOTH
+    # processes' distinct cursors, and each process picked its own back
+    for pid, r in enumerate(results):
+        c = r["cursor"]
+        assert c["process_count"] == 2 and c["batches"] == 5
+        assert c["mine"] == [[pid, 10 + pid]]
+        assert c["all"] == [[[0, 10]], [[1, 11]]]
+        assert c["mismatch_dropped"] is True
+
     # single-process reference on the same global batches + full valid set
     ref = worker.run_leg(shards)
     np.testing.assert_allclose(
