@@ -55,12 +55,53 @@ class MeshConfig:
         return tuple(sizes)  # type: ignore[return-value]
 
 
+def plan_hybrid_mesh(
+    sizes: tuple[int, int, int, int], n_slices: int
+) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Split the resolved axis sizes into (per-slice ICI shape, DCN shape)
+    for a multislice deployment: only the ``data`` axis may span slices
+    (the slow DCN fabric carries gradient all-reduce, which overlaps well),
+    while fsdp/tensor/seq — whose collectives sit on the critical path —
+    stay inside a slice on ICI."""
+    data, fsdp, tensor, seq = sizes
+    if data % n_slices:
+        raise ValueError(
+            f"data axis ({data}) must be divisible by the slice count "
+            f"({n_slices}) — only the data axis spans DCN"
+        )
+    return (data // n_slices, fsdp, tensor, seq), (n_slices, 1, 1, 1)
+
+
+def mesh_strategy(slice_ids: list[int], sizes: tuple[int, int, int, int]) -> str:
+    """Decide how to lay devices out: ``"hybrid"`` (slice-aligned
+    ICI×DCN mesh) only when every slice is fully used AND the data axis is
+    divisible by the slice count; otherwise ``"flat"`` — which always works
+    (it is the pre-multislice behavior), just with suboptimal fabric
+    placement, so a default config never hard-fails on multislice hardware.
+    """
+    n_slices = len(set(slice_ids))
+    if n_slices <= 1:
+        return "flat"
+    per_slice_counts = {s: slice_ids.count(s) for s in set(slice_ids)}
+    if len(set(per_slice_counts.values())) != 1:
+        return "flat"  # truncated sub-mesh straddles a slice boundary
+    if sizes[0] % n_slices:
+        return "flat"
+    return "hybrid"
+
+
 def create_mesh(
     config: MeshConfig | None = None, devices: list | None = None
 ) -> Mesh:
     """Build the global mesh. Axis order is (data, fsdp, tensor, seq) —
     outermost axis maps to the slowest fabric (DCN between slices), innermost
     to ICI neighbors, matching ``mesh_utils.create_device_mesh`` conventions.
+
+    Multislice (DCN) is detected from the devices' ``slice_index``: with more
+    than one slice the mesh is built with ``create_hybrid_device_mesh`` so
+    slice boundaries land exactly on the data axis — a flat
+    ``create_device_mesh`` would interleave slices and put fsdp/tensor
+    collectives onto DCN.
     """
     devices = devices if devices is not None else jax.devices()
     config = config or MeshConfig()
@@ -69,8 +110,24 @@ def create_mesh(
     devices = devices[:n_used]  # explicit sub-mesh (tests, single-chip bench)
     from jax.experimental import mesh_utils
 
-    if n_used == 1:
-        dev_array = np.array(devices).reshape(sizes)
+    slice_ids = [getattr(d, "slice_index", 0) for d in devices]
+    strategy = mesh_strategy(slice_ids, sizes)
+    n_slices = len(set(slice_ids))
+    if strategy == "hybrid":
+        per_slice, dcn = plan_hybrid_mesh(sizes, n_slices)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            per_slice, dcn, devices=devices
+        )
     else:
-        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+        if n_slices > 1:
+            print(
+                f"[mesh] WARNING: {n_slices} slices but mesh "
+                f"{dict(zip(AXES, sizes))} is not slice-aligned (data axis "
+                f"must be a multiple of {n_slices} and use every device); "
+                "building a flat mesh — fsdp/tensor collectives may ride DCN"
+            )
+        if n_used == 1:
+            dev_array = np.array(devices).reshape(sizes)
+        else:
+            dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
     return Mesh(dev_array, AXES)

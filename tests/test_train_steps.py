@@ -69,6 +69,39 @@ def build(mesh_cfg, module, mode, grad_accum=1, batch=None, opt=OPT):
     return mesh, state, sharding, step
 
 
+class TestMeshPlanning:
+    def test_hybrid_mesh_plan_splits_data_axis_over_dcn(self):
+        """Multislice planning: only the data axis spans slices; fsdp/
+        tensor/seq stay intra-slice on ICI."""
+        from jumbo_mae_tpu_tpu.parallel.mesh import plan_hybrid_mesh
+
+        per_slice, dcn = plan_hybrid_mesh((32, 4, 1, 1), n_slices=4)
+        assert per_slice == (8, 4, 1, 1)
+        assert dcn == (4, 1, 1, 1)
+        # elementwise product reconstructs the global mesh shape
+        assert tuple(a * b for a, b in zip(per_slice, dcn)) == (32, 4, 1, 1)
+
+    def test_hybrid_mesh_plan_rejects_indivisible_data_axis(self):
+        from jumbo_mae_tpu_tpu.parallel.mesh import plan_hybrid_mesh
+
+        with pytest.raises(ValueError, match="data axis"):
+            plan_hybrid_mesh((6, 2, 1, 1), n_slices=4)
+
+    def test_mesh_strategy_decision(self):
+        """Hybrid only when slice-aligned; everything else falls back to a
+        flat mesh (the pre-multislice behavior) so a default config never
+        hard-fails on multislice hardware."""
+        from jumbo_mae_tpu_tpu.parallel.mesh import mesh_strategy
+
+        two_slices = [0] * 4 + [1] * 4
+        assert mesh_strategy([0] * 8, (1, 8, 1, 1)) == "flat"  # single slice
+        assert mesh_strategy(two_slices, (2, 4, 1, 1)) == "hybrid"
+        # default config (data=1) on 2 slices: flat, not an error
+        assert mesh_strategy(two_slices, (1, 8, 1, 1)) == "flat"
+        # truncation straddling a slice boundary: flat
+        assert mesh_strategy([0, 0, 0, 0, 1, 1], (2, 3, 1, 1)) == "flat"
+
+
 class TestPretrainStep:
     def test_loss_decreases(self):
         batch = batch_of(16)
