@@ -22,10 +22,14 @@ import numpy as np
 MODELS = {
     "vit_l16": dict(dec=dict(layers=8, dim=512, heads=16), batch=128, remat=False),
     # batch 64 + dots-saveable remat measured fastest on 16 GB v5e (PERF.md:
-    # 244 img/s vs 166 at the round-1 batch-32 full-remat config; 96 OOMs)
+    # 244 img/s vs 166 at the round-1 batch-32 full-remat config; 96 OOMs).
+    # The reference-style f32 leg doubles every activation, so it gets its
+    # own largest-fitting batch (64 f32 needs ~20 GB); the ratio compares
+    # per-image throughput, each leg at its feasible batch.
     "vit_h14": dict(
         dec=dict(layers=8, dim=512, heads=16),
         batch=64,
+        f32_batch=32,
         remat=True,
         remat_policy="dots",
     ),
@@ -188,8 +192,19 @@ def main():
         # The baseline leg (reference-style fp32 compute, same workload)
         # gets IDENTICAL warmup/iters/rounds so the ratio is two equally
         # converged measurements, not a converged one over a noisy one.
+        # f32 doubles activation memory; models that need a smaller f32
+        # batch declare it, and the ratio compares per-image throughput.
+        # never larger than the bf16 leg's batch: a user-shrunk BENCH_BATCH
+        # must shrink the f32 leg too (its declared batch is sized for the
+        # default config's memory envelope)
+        batch_f32 = int(
+            os.environ.get(
+                "BENCH_F32_BATCH",
+                str(min(MODELS[model].get("f32_batch", batch_size), batch_size)),
+            )
+        )
         step_f32, state_f32, batch, floor_f32 = build_step(
-            "float32", batch_size, model
+            "float32", batch_f32, model
         )
         dt_f32 = time_steps(
             step_f32,
@@ -199,8 +214,10 @@ def main():
             iters=iters,
             min_plausible_ms=floor_f32,
         )
-        result["vs_baseline"] = round(dt_f32 / dt, 3)
+        result["vs_baseline"] = round(imgs_per_sec / (batch_f32 / dt_f32), 3)
         result["ms_step_f32"] = round(dt_f32 * 1e3, 2)
+        if batch_f32 != batch_size:
+            result["f32_batch"] = batch_f32
 
     print(json.dumps(result))
 
