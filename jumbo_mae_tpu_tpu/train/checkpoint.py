@@ -37,6 +37,46 @@ import numpy as np
 import orbax.checkpoint as ocp
 from flax import serialization
 
+from jumbo_mae_tpu_tpu.data.tario import open_url
+
+
+def is_remote_path(path) -> bool:
+    """True for URL-scheme paths that must NOT go through ``pathlib.Path``
+    (which would mangle ``gs://b/x`` into the local path ``gs:/b/x``).
+    These route through ``open_url`` for stream IO and ``checkpoint_root``
+    for directory handles."""
+    return str(path).startswith(
+        ("pipe:", "gs://", "http://", "https://", "file://")
+    )
+
+
+def checkpoint_root(directory: str):
+    """Map a checkpoint directory string to the path object handed to Orbax.
+
+    Local paths (incl. ``file://``) become absolute ``pathlib.Path``;
+    URL-scheme paths (``gs://`` etc.) become ``etils.epath.Path`` — Orbax's
+    own path type — so the scheme survives verbatim (parity with the
+    reference writing checkpoints straight to GCS URLs,
+    ``/root/reference/src/utils.py:55-63``). ``pipe:`` is stream-only and
+    rejected: it can carry a msgpack params file but not a managed
+    checkpoint directory.
+    """
+    s = str(directory)
+    if s.startswith("pipe:"):
+        raise ValueError(
+            "pipe: URLs are stream-only — usable for msgpack params "
+            "export/import, not as a checkpoint directory"
+        )
+    if s.startswith("file://"):
+        from urllib.parse import urlparse
+
+        return Path(urlparse(s).path).absolute()
+    if is_remote_path(s):
+        from etils import epath
+
+        return epath.Path(s)
+    return Path(directory).absolute()
+
 # --------------------------------------------------------------------------
 # RNG-key plumbing: typed PRNG keys are stored as their uint32 key data.
 # --------------------------------------------------------------------------
@@ -106,16 +146,16 @@ class Checkpointer:
 
     def __init__(self, cfg: CheckpointConfig):
         self.cfg = cfg
-        root = Path(cfg.directory)
+        root = checkpoint_root(cfg.directory)
         opts = dict(enable_async_checkpointing=cfg.async_save)
         self._last = ocp.CheckpointManager(
-            (root / "last").absolute(),
+            root / "last",
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=cfg.max_keep_last, **opts
             ),
         )
         self._best = ocp.CheckpointManager(
-            (root / "best").absolute(),
+            root / "best",
             options=ocp.CheckpointManagerOptions(max_to_keep=1, **opts),
         )
         self._best_metric = self._read_best_metric()
@@ -342,12 +382,22 @@ def load_pretrained_params(
     merged across the rename — a pretrain checkpoint's decoder params are
     dropped for finetune. Pass an explicit key or ``None`` for whole-tree
     merge.
+
+    ``path`` may be an Orbax checkpoint dir (local or ``gs://``), a local
+    ``.msgpack`` file, or a stream URL (``pipe:``, ``http(s)://``, or any
+    remote path ending in ``.msgpack``) carrying a msgpack params file.
     """
-    p = Path(path)
-    if p.is_dir():
-        tree = restore_params_any(p)
+    s = str(path)
+    if s.startswith(("pipe:", "http://", "https://")) or (
+        is_remote_path(s) and s.endswith(".msgpack")
+    ):
+        tree = import_params_msgpack(s)
     else:
-        tree = import_params_msgpack(p)
+        p = checkpoint_root(s)
+        if p.is_dir():
+            tree = restore_params_any(p)
+        else:
+            tree = import_params_msgpack(s)
     tree = serialization.to_state_dict(tree)
     init_sd = serialization.to_state_dict(init_params)
 
@@ -372,12 +422,13 @@ def load_pretrained_params(
     return serialization.from_state_dict(init_params, merged)
 
 
-def restore_params_any(directory: Path) -> dict:
+def restore_params_any(directory) -> dict:
     """Restore just the params tree from a Checkpointer layout (best/ or
-    last/ subdirs, or a direct manager dir)."""
-    directory = Path(directory)
+    last/ subdirs, or a direct manager dir). ``directory`` may be local or a
+    ``gs://`` URL (routed through :func:`checkpoint_root`)."""
+    directory = checkpoint_root(directory)
     for sub in ("best", "last", "."):
-        root = (directory / sub).resolve()
+        root = directory if sub == "." else directory / sub
         if root.is_dir():
             with ocp.CheckpointManager(root) as mgr:
                 step = mgr.latest_step()
@@ -403,16 +454,23 @@ _background_writers: list[threading.Thread] = []
 
 
 def export_params_msgpack(params, path: str, *, background: bool = False):
-    """Write a reference-compatible params msgpack. With ``background=True``
-    the write happens on a tracked thread that is joined at interpreter exit
-    (the reference's thread was fire-and-forget → truncation risk,
-    ``/root/reference/src/utils.py:58-63``)."""
+    """Write a reference-compatible params msgpack — to a local path or any
+    ``open_url`` write target (``gs://``, ``pipe:CMD``), matching the
+    reference's gopen-based URL writes (``/root/reference/src/utils.py:55-63``).
+    With ``background=True`` the write happens on a tracked thread that is
+    joined at interpreter exit (the reference's thread was fire-and-forget →
+    truncation risk, ``/root/reference/src/utils.py:58-63``)."""
     host_params = jax.tree_util.tree_map(np.asarray, params)
     payload = serialization.msgpack_serialize(
         serialization.to_state_dict(host_params)
     )
 
     def write():
+        if is_remote_path(path):
+            # remote stores commit on stream close; no tmp-rename dance
+            with open_url(path, "wb") as s:
+                s.write(payload)
+            return
         target = Path(path)
         target.parent.mkdir(parents=True, exist_ok=True)
         tmp = target.with_suffix(target.suffix + ".tmp")
@@ -428,6 +486,12 @@ def export_params_msgpack(params, path: str, *, background: bool = False):
 
 
 def import_params_msgpack(path: str) -> dict:
+    """Read a params msgpack from a local path or any ``open_url`` read
+    source (``gs://``, ``pipe:``, ``http(s)://`` — parity with the reference
+    reading pretrained files via gopen, ``/root/reference/src/utils.py:150-152``)."""
+    if is_remote_path(path):
+        with open_url(path, "rb") as s:
+            return serialization.msgpack_restore(s.read())
     return serialization.msgpack_restore(Path(path).read_bytes())
 
 
@@ -438,6 +502,6 @@ def _join_background_writers():
 
 
 def save_metadata_json(directory: str, payload: dict):
-    p = Path(directory)
+    p = checkpoint_root(directory)  # epath for gs:// etc., Path locally
     p.mkdir(parents=True, exist_ok=True)
     (p / "metadata.json").write_text(json.dumps(payload, indent=2, default=str))
