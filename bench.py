@@ -129,6 +129,8 @@ def acquire_backend(
 
 
 MODELS = {
+    # test-sized smoke config: fast bench/profile sanity on any backend
+    "vit_t16": dict(dec=dict(layers=2, dim=64, heads=4), batch=8, remat=False),
     "vit_l16": dict(dec=dict(layers=8, dim=512, heads=16), batch=128, remat=False),
     # batch 64 + dots-saveable remat measured fastest on 16 GB v5e (PERF.md:
     # 244 img/s vs 166 at the round-1 batch-32 full-remat config; 96 OOMs).

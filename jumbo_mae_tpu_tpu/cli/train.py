@@ -292,10 +292,13 @@ def _pick_process_cursor(data_cursor: dict | None) -> dict | None:
         )
         return None
     if "per_process" in data_cursor:
-        return {
+        picked = {
             "workers": data_cursor["per_process"][jax.process_index()],
             "batches": data_cursor["batches"],
         }
+        if data_cursor.get("native_threads") is not None:
+            picked["native_threads"] = data_cursor["native_threads"]
+        return picked
     return data_cursor
 
 
@@ -313,11 +316,16 @@ def _gather_data_cursor(snap: dict | None) -> dict | None:
     gathered = multihost_utils.process_allgather(
         np.asarray(snap["workers"], np.int64)
     )
-    return {
+    out = {
         "per_process": gathered.tolist(),
         "batches": snap["batches"],
         "process_count": jax.process_count(),
     }
+    # substrate marker must survive the gather or a native-IO cursor can
+    # never restore on a pod (and would mis-resume on the worker path)
+    if snap.get("native_threads") is not None:
+        out["native_threads"] = snap["native_threads"]
+    return out
 
 
 def evaluate(eval_step, state, batches, pad_batch: dict | None = None) -> dict[str, float]:
@@ -465,6 +473,11 @@ def train(cfg: TrainConfig) -> dict:
             state, metrics = train_step(state, next(train_iter))
             pending.append(metrics)  # device arrays; fetched at log time
             timer.tick()
+            # only cursor_log[step] (and prefetched future steps) are ever
+            # read — prune dead entries every iteration, not just at save
+            # time, or sparse checkpointing grows host memory without bound
+            for k in [k for k in cursor_log if k < step]:
+                del cursor_log[k]
 
             if step % run.log_interval == 0 or step == run.training_steps:
                 # sync ONLY at log boundaries — per-step device_get/block

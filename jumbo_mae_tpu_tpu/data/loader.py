@@ -245,16 +245,22 @@ def native_train_stream(
     process_index: int = 0,
     process_count: int = 1,
     start_epoch: int = 0,
+    skip_samples: int = 0,
+    cursor: StreamCursor | None = None,
 ) -> Iterator[tuple[np.ndarray, int]]:
     """Native-IO train stream: C++ reader threads feed raw image bytes, a
     thread pool does decode+augment (cv2/PIL release the GIL, so this scales
     within one process where the pure-Python path needs worker processes).
 
     One epoch of the process's shard stripe per native reader; shard order is
-    reshuffled per epoch like :func:`train_sample_stream`. NOT sample-exactly
-    resumable: the C++ reader threads interleave shards in run-dependent
-    order, so a skipped prefix would not be the consumed prefix — resume on
-    this path is epoch-granular only (``start_epoch``).
+    reshuffled per epoch like :func:`train_sample_stream`. SAMPLE-EXACTLY
+    RESUMABLE: the C++ reader gives each thread static ownership of every
+    T-th shard and merges thread queues in strict round-robin
+    (``native/tario.cc``), so the sample order is a pure function of
+    (shard list, ``native_io_threads``) and ``skip_samples`` replays the
+    consumed prefix exactly, same contract as :func:`train_sample_stream`
+    (decode and shuffle-buffer draws replay; the augmentation transform is
+    skipped).
     """
     from concurrent.futures import ThreadPoolExecutor
 
@@ -263,6 +269,7 @@ def native_train_stream(
     shards = expand_shards(cfg.train_shards)
     transform = TrainTransform(cfg)
     epoch = start_epoch
+    to_skip = max(0, skip_samples)
     with ThreadPoolExecutor(max_workers=max(1, cfg.decode_threads)) as pool:
         while True:
             rng = np.random.default_rng((cfg.seed, 2, process_index, epoch))
@@ -304,9 +311,16 @@ def native_train_stream(
                     decoded(reader), cfg.shuffle_buffer, rng
                 ):
                     for _ in range(cfg.repeats):
+                        if to_skip > 0:
+                            to_skip -= 1
+                            idx += 1
+                            continue
                         aug = _aug_rng(cfg.seed, process_index, 0, epoch, idx)
+                        out = transform(aug, img), label
                         idx += 1
-                        yield transform(aug, img), label
+                        if cursor is not None:
+                            cursor.epoch, cursor.offset = epoch, idx
+                        yield out
             epoch += 1
 
 
@@ -384,6 +398,9 @@ class _Worker:
 
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"  # belt and braces; workers never use jax
+        # and don't register remote-accelerator PJRT plugins in them either:
+        # a wedged tunnel must never be able to touch data-worker startup
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         repo_root = str(Path(__file__).resolve().parent.parent.parent)
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         self.proc = subprocess.Popen(
@@ -463,24 +480,53 @@ class TrainLoader:
         self.batch_size = batch_size
         self._workers: list[_Worker] = []
         if cfg.use_native:
+            # the C++ reader's deterministic per-thread shard ownership +
+            # round-robin merge makes this stream a pure function of
+            # (config, native_io_threads) — but only for the SAME thread
+            # count, so a cursor records it and resume validates it
             if cursor is not None:
-                raise ValueError(
-                    "native-IO streams interleave shards in thread-dependent "
-                    "order and are not sample-exactly resumable — resume "
-                    "with the epoch cursor (start_epoch) instead"
-                )
-            self._cursors: list[tuple[int, int]] = []
-            self.batches_yielded = 0
+                saved_threads = cursor.get("native_threads")
+                if saved_threads is None:
+                    raise ValueError(
+                        "resume cursor was written by the subprocess-worker "
+                        "loader (different sample order); restart with "
+                        "data.use_native=false or fall back to epoch resume"
+                    )
+                if saved_threads != cfg.native_io_threads:
+                    raise ValueError(
+                        f"resume cursor was written with native_io_threads="
+                        f"{saved_threads} but the loader is configured with "
+                        f"{cfg.native_io_threads} — the merged sample order "
+                        "differs; restart with the checkpointed thread count"
+                    )
+                (start, skip) = tuple(cursor["workers"][0])
+                self.batches_yielded = int(cursor["batches"])
+            else:
+                start, skip = start_epoch, 0
+                self.batches_yielded = 0
+            self._native_threads = cfg.native_io_threads
+            self._cursors = [(start, skip)]
+            track = StreamCursor(start, skip)
             self._stream = native_train_stream(
                 cfg,
                 process_index=process_index,
                 process_count=process_count,
-                start_epoch=start_epoch,
+                start_epoch=start,
+                skip_samples=skip,
+                cursor=track,
             )
-            self._inline = batch_train_samples(self._stream, batch_size, cfg.repeats)
+            self._inline = batch_train_samples(
+                self._stream, batch_size, cfg.repeats, cursor=track
+            )
             return
         n_streams = 1 if cfg.workers <= 0 else cfg.workers
         if cursor is not None:
+            if cursor.get("native_threads") is not None:
+                raise ValueError(
+                    "resume cursor was written by the native-IO loader "
+                    "(round-robin-over-threads sample order); restart with "
+                    "data.use_native=true or fall back to epoch resume"
+                )
             starts = [tuple(c) for c in cursor["workers"]]
             if len(starts) != n_streams:
                 raise ValueError(
@@ -525,15 +571,18 @@ class TrainLoader:
             self._workers.append(_Worker(spec, per_worker_q))
 
     def snapshot(self) -> dict | None:
-        """Resume cursor as of the last batch returned by ``__next__``, or
-        ``None`` when the substrate can't support sample-exact resume
-        (native-IO: thread-interleaved shard order)."""
+        """Resume cursor as of the last batch returned by ``__next__``.
+        Native-IO snapshots also record the reader thread count — the
+        deterministic merge order depends on it, so resume validates it."""
         if not self._cursors:
             return None
-        return {
+        snap = {
             "workers": [list(c) for c in self._cursors],
             "batches": self.batches_yielded,
         }
+        if getattr(self, "_native_threads", None) is not None:
+            snap["native_threads"] = self._native_threads
+        return snap
 
     def __iter__(self):
         return self

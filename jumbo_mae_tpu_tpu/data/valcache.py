@@ -80,7 +80,10 @@ class ValidSampleCache:
         finally:
             if finished:
                 tmp.replace(self.data_path)
-                self.meta_path.write_text(
+                # atomic like the .bin commit: a racing reader must never
+                # see a truncated JSON
+                meta_tmp = self.meta_path.with_suffix(".json.tmp")
+                meta_tmp.write_text(
                     json.dumps(
                         {
                             "count": len(labels),
@@ -90,6 +93,7 @@ class ValidSampleCache:
                         default=str,
                     )
                 )
+                meta_tmp.replace(self.meta_path)
             else:
                 tmp.unlink(missing_ok=True)
 

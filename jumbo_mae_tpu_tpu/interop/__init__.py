@@ -5,11 +5,13 @@ from jumbo_mae_tpu_tpu.interop.reference_convert import (
 )
 from jumbo_mae_tpu_tpu.interop.torch_convert import (
     flax_to_torch_state,
+    timm_plain_vit_to_jumbo_state,
     torch_to_flax_params,
 )
 
 __all__ = [
     "flax_to_torch_state",
+    "timm_plain_vit_to_jumbo_state",
     "torch_to_flax_params",
     "reference_encoder_to_jumbo",
     "reference_head_batch_stats_to_jumbo",

@@ -25,7 +25,11 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["flax_to_torch_state", "torch_to_flax_params"]
+__all__ = [
+    "flax_to_torch_state",
+    "timm_plain_vit_to_jumbo_state",
+    "torch_to_flax_params",
+]
 
 
 def _np(x) -> np.ndarray:
@@ -147,6 +151,52 @@ def flax_to_torch_state(params: dict, batch_stats: dict | None = None) -> dict:
     return out
 
 
+def timm_plain_vit_to_jumbo_state(
+    state: dict, *, num_cls_tokens: int = 3
+) -> dict:
+    """Adapt a PLAIN-ViT timm state dict (single ``cls_token``, CLS position
+    baked into ``pos_embed``) to the extended-jumbo torch grammar consumed by
+    :func:`torch_to_flax_params` — the timm-hub import workflow the reference
+    documented (``/root/reference/scripts/convert_pytorch_to_flax.py:24-51``,
+    ``/root/reference/README.md:130-146``), retargeted at the jumbo layout:
+
+    - the CLS positional embedding folds into the token (as the reference
+      did) and the token is tiled to ``num_cls_tokens`` — every jumbo CLS
+      slot starts from the pretrained one;
+    - ``pos_embed`` drops the CLS slot, leaving the patch-only grid;
+    - blocks/norm keys already share the timm grammar and pass through;
+    - the jumbo head reads the K CLS embeddings *concatenated* (input K·D,
+      ``models/vit.py``), so the plain head weight (L, D) becomes
+      (L, K·D) as K copies scaled by 1/K — when the K CLS slots carry the
+      same embedding (as they do right after this import), the logits
+      equal the plain model's;
+    - there is no pretrained source for the shared jumbo MLP — it stays
+      absent so a warm-start merge keeps its fresh init.
+    """
+    state = {k: _np(v) for k, v in state.items()}
+    out = {k: v for k, v in state.items() if k not in ("cls_token", "pos_embed")}
+    if "head.weight" in state:
+        out["head.weight"] = np.tile(
+            state["head.weight"] / num_cls_tokens, (1, num_cls_tokens)
+        )
+    cls = state.get("cls_token")  # (1, 1, D); absent on GAP-pooled models
+    if "pos_embed" in state:
+        pe = state["pos_embed"]  # (1, 1 + N, D) — CLS position first
+        n_patches = pe.shape[1] - (1 if cls is not None else 0)
+        side = int(round(np.sqrt(n_patches)))
+        if cls is not None and side * side == n_patches:
+            cls = cls + pe[:, :1, :]
+            out["pos_embed"] = pe[:, 1:, :]
+        else:
+            # no CLS slot (GAP model) or non-square grid: pass through
+            out["pos_embed"] = pe
+    if cls is not None:
+        out["cls_tokens"] = np.tile(cls, (1, num_cls_tokens, 1))
+    # else: GAP-pooled source has no CLS token — leave cls_tokens absent so
+    # a warm-start merge keeps the jumbo model's fresh init for them
+    return out
+
+
 def torch_to_flax_params(state: dict, *, heads: int) -> dict:
     """Inverse of :func:`flax_to_torch_state`: torch-style flat dict → bare
     jumbo encoder tree (nest under ``model``/``encoder`` for warm starts via
@@ -156,7 +206,9 @@ def torch_to_flax_params(state: dict, *, heads: int) -> dict:
     state = {k: _np(v) for k, v in state.items()}
     enc: dict = {}
 
-    enc["cls_tokens"] = state["cls_tokens"]
+    if "cls_tokens" in state:
+        enc["cls_tokens"] = state["cls_tokens"]
+    # else: GAP-pooled source (no CLS) — warm-start merge keeps fresh init
     embed: dict = {
         "proj": {
             "kernel": state["patch_embed.proj.weight"].transpose(2, 3, 1, 0),
@@ -199,12 +251,15 @@ def torch_to_flax_params(state: dict, *, heads: int) -> dict:
         }
         enc[f"block_{i}"] = blk
 
-    enc["jumbo_mlp"] = {
-        fc: _linear_from_torch(
-            state[f"jumbo_mlp.{fc}.weight"], state[f"jumbo_mlp.{fc}.bias"]
-        )
-        for fc in ("fc1", "fc2")
-    }
+    if "jumbo_mlp.fc1.weight" in state:
+        enc["jumbo_mlp"] = {
+            fc: _linear_from_torch(
+                state[f"jumbo_mlp.{fc}.weight"], state[f"jumbo_mlp.{fc}.bias"]
+            )
+            for fc in ("fc1", "fc2")
+        }
+    # else: plain-ViT source (e.g. a timm hub checkpoint) has no shared
+    # jumbo MLP — leave the key out; a warm-start merge keeps fresh init.
     enc["ln"] = {"scale": state["norm.weight"], "bias": state["norm.bias"]}
 
     head: dict = {}

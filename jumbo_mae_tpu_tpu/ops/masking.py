@@ -108,7 +108,10 @@ def all_mask(x: jax.Array) -> jax.Array:
 
 
 def mask_not(mask: jax.Array) -> jax.Array:
-    return 1.0 - (mask > 0).astype(jnp.float32)
+    """``1.0 - mask`` — exact reference semantics: unlike union/intersection
+    (which binarize with the reference's ``>0`` contract), the reference's
+    complement is pure arithmetic, so a soft 0.3 inverts to 0.7."""
+    return 1.0 - mask.astype(jnp.float32)
 
 
 def mask_union(*masks: jax.Array) -> jax.Array:

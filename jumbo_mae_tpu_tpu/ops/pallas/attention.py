@@ -32,6 +32,7 @@ automatically, at some efficiency cost).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -42,8 +43,13 @@ NEG_INF = -1e30
 # sublane tile) rather than 128: Mosaic accepts sub-lane-width minor dims
 # with masked loads, and the 16× slimmer HBM buffers matter at scale — at
 # the ViT-H bench shapes the 128-wide broadcast was ~840 MB of transient
-# per buffer; gradient parity at width 8 is verified on-device.
-LANE = 8
+# per buffer; gradient parity at width 8 is verified on-device (v5e).
+# Mosaic's acceptance of sub-128 minor dims varies by TPU generation and
+# compiler version: if compilation fails on another device kind with a
+# Mosaic layout/lane error pointing at the lse/delta buffers, set
+# JUMBO_PALLAS_LANE=128 — full-lane residual buffers, identical numerics,
+# just fatter HBM transients.
+LANE = int(os.environ.get("JUMBO_PALLAS_LANE", "8"))
 
 
 def _mask_cols(s, col0: int, valid_k: int):

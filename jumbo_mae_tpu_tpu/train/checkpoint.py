@@ -50,6 +50,16 @@ def is_remote_path(path) -> bool:
     )
 
 
+def _strip_file_scheme(path) -> str:
+    """``file:///x/y`` → ``/x/y``; everything else unchanged."""
+    s = str(path)
+    if s.startswith("file://"):
+        from urllib.parse import urlparse
+
+        return urlparse(s).path
+    return s
+
+
 def checkpoint_root(directory: str):
     """Map a checkpoint directory string to the path object handed to Orbax.
 
@@ -68,9 +78,7 @@ def checkpoint_root(directory: str):
             "export/import, not as a checkpoint directory"
         )
     if s.startswith("file://"):
-        from urllib.parse import urlparse
-
-        return Path(urlparse(s).path).absolute()
+        return Path(_strip_file_scheme(s)).absolute()
     if is_remote_path(s):
         from etils import epath
 
@@ -466,12 +474,13 @@ def export_params_msgpack(params, path: str, *, background: bool = False):
     )
 
     def write():
-        if is_remote_path(path):
+        if is_remote_path(path) and not str(path).startswith("file://"):
             # remote stores commit on stream close; no tmp-rename dance
             with open_url(path, "wb") as s:
                 s.write(payload)
             return
-        target = Path(path)
+        # local (incl. file://): parent mkdir + atomic tmp-rename commit
+        target = Path(_strip_file_scheme(path))
         target.parent.mkdir(parents=True, exist_ok=True)
         tmp = target.with_suffix(target.suffix + ".tmp")
         tmp.write_bytes(payload)

@@ -6,12 +6,20 @@
 // framework's Python loader an alternative substrate: N reader THREADS in
 // one process stream disjoint stripes of tar shards, parse ustar headers,
 // group members into samples (key = basename up to first dot), and push
-// them into one bounded MPMC queue the GIL-free way; Python pops raw
+// them into bounded queues the GIL-free way; Python pops raw
 // (image-bytes, label) pairs and keeps decode/augment in cv2/numpy.
 //
+// DETERMINISTIC ORDER: thread t statically owns shards t, t+T, t+2T, ...
+// and fills its own queue; the (single) consumer merges queues in strict
+// round-robin, skipping exhausted threads at the deterministic point where
+// their stripe ends. The output sequence is therefore a pure function of
+// (shard list, thread count) — same contract as the Python worker path —
+// which is what makes sample-exact resume possible on this substrate.
+//
 // Corrupt members/truncated shards are skipped (the reference's
-// ignore_and_continue contract). Supports plain files and "pipe:CMD" URLs
-// (popen), matching data/tario.py.
+// ignore_and_continue contract — deterministic too: same bytes, same
+// skips). Supports plain files and "pipe:CMD" URLs (popen), matching
+// data/tario.py.
 //
 // Build: g++ -O2 -shared -fPIC -o libtario.so tario.cc -lpthread
 
@@ -22,6 +30,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -144,22 +153,30 @@ struct Reader;
 
 struct Handle {
   std::vector<std::string> urls;
-  BoundedQueue queue;
+  // one queue per reader thread: the consumer's round-robin merge over
+  // these is what makes the output order deterministic
+  std::vector<std::unique_ptr<BoundedQueue>> queues;
   std::vector<std::thread> threads;
-  std::atomic<size_t> next_shard{0};
+  std::vector<bool> exhausted;  // consumer-side; single consumer only
+  size_t rr = 0;
   bool loop;
 
-  Handle(size_t cap, bool loop_) : queue(cap), loop(loop_) {}
+  explicit Handle(bool loop_) : loop(loop_) {}
 };
 
-void reader_main(Handle* h) {
+void reader_main(Handle* h, size_t tid) {
+  BoundedQueue* q = h->queues[tid].get();
+  size_t n_threads = h->queues.size();
   char header[512];
-  for (;;) {
-    size_t idx = h->next_shard.fetch_add(1);
-    if (idx >= h->urls.size()) {
+  // static stripe: tid, tid+T, tid+2T, ... (never work-stealing — the
+  // stripe assignment must be a pure function of the shard list)
+  for (size_t pos = tid;; pos += n_threads) {
+    if (pos >= h->urls.size()) {
       if (!h->loop) break;
-      idx %= h->urls.size();
+      pos = tid;
+      if (pos >= h->urls.size()) break;  // more threads than shards
     }
+    size_t idx = pos;
     Stream in;
     if (!in.open(h->urls[idx])) continue;
 
@@ -210,7 +227,7 @@ void reader_main(Handle* h) {
       split_name(name, &stem, &ext);
       if (stem != cur_stem) {
         if (cur && !cur->image.empty()) {
-          if (!h->queue.push(cur)) { delete cur; in.close(); return; }
+          if (!q->push(cur)) { delete cur; in.close(); q->producer_done(); return; }
         } else {
           delete cur;
         }
@@ -229,13 +246,13 @@ void reader_main(Handle* h) {
       }
     }
     if (cur && !cur->image.empty()) {
-      if (!h->queue.push(cur)) { delete cur; in.close(); return; }
+      if (!q->push(cur)) { delete cur; in.close(); q->producer_done(); return; }
     } else {
       delete cur;
     }
     in.close();
   }
-  h->queue.producer_done();
+  q->producer_done();
 }
 
 }  // namespace
@@ -245,44 +262,69 @@ extern "C" {
 // urls: NUL-separated, double-NUL terminated. Returns opaque handle.
 void* tario_open(const char* urls, int n_threads, int queue_capacity,
                  int loop) {
-  auto* h = new Handle((size_t)queue_capacity, loop != 0);
+  auto* h = new Handle(loop != 0);
   const char* p = urls;
   while (*p) {
     h->urls.emplace_back(p);
     p += h->urls.back().size() + 1;
   }
   if (n_threads < 1) n_threads = 1;
-  h->queue.producers_left = n_threads;
+  size_t per_q = (size_t)queue_capacity / (size_t)n_threads;
+  if (per_q < 2) per_q = 2;
+  for (int i = 0; i < n_threads; ++i) {
+    h->queues.emplace_back(new BoundedQueue(per_q));
+    h->queues.back()->producers_left = 1;
+  }
+  h->exhausted.assign((size_t)n_threads, false);
   for (int i = 0; i < n_threads; ++i)
-    h->threads.emplace_back(reader_main, h);
+    h->threads.emplace_back(reader_main, h, (size_t)i);
   return h;
 }
 
-// Pops one sample. Returns 1 on success, 0 on end-of-stream.
-// On success *out_data/*out_len hold the image bytes (valid until
-// tario_free), *out_label the class (-1 if absent).
+// Pops one sample in deterministic round-robin order over the reader
+// threads' queues. Returns 1 on success, 0 on end-of-stream. Single
+// consumer only. On success *out_data/*out_len hold the image bytes
+// (valid until tario_free), *out_label the class (-1 if absent).
 int tario_next(void* handle, const uint8_t** out_data, int64_t* out_len,
                int64_t* out_label, void** out_token) {
   auto* h = static_cast<Handle*>(handle);
-  Sample* s = h->queue.pop();
-  if (!s) return 0;
-  *out_data = s->image.data();
-  *out_len = (int64_t)s->image.size();
-  *out_label = s->label;
-  *out_token = s;
-  return 1;
+  size_t n = h->queues.size();
+  for (;;) {
+    bool all_done = true;
+    for (size_t k = 0; k < n; ++k)
+      if (!h->exhausted[k]) { all_done = false; break; }
+    if (all_done) return 0;
+    size_t i = h->rr;
+    h->rr = (h->rr + 1) % n;
+    if (h->exhausted[i]) continue;
+    // blocks on THIS thread's queue even if others have data — strict
+    // round-robin is the determinism contract, and per-queue prefetch
+    // keeps the wait short in steady state
+    Sample* s = h->queues[i]->pop();
+    if (!s) {
+      h->exhausted[i] = true;  // its stripe ended at a deterministic point
+      continue;
+    }
+    *out_data = s->image.data();
+    *out_len = (int64_t)s->image.size();
+    *out_label = s->label;
+    *out_token = s;
+    return 1;
+  }
 }
 
 void tario_free(void* token) { delete static_cast<Sample*>(token); }
 
 void tario_close(void* handle) {
   auto* h = static_cast<Handle*>(handle);
-  h->queue.close();
+  for (auto& q : h->queues) q->close();
   for (auto& t : h->threads) t.join();
   // drain anything left
-  std::lock_guard<std::mutex> lk(h->queue.mu);
-  for (Sample* s : h->queue.items) delete s;
-  h->queue.items.clear();
+  for (auto& q : h->queues) {
+    std::lock_guard<std::mutex> lk(q->mu);
+    for (Sample* s : q->items) delete s;
+    q->items.clear();
+  }
   delete h;
 }
 

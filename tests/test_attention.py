@@ -115,3 +115,48 @@ class TestPallasKernel:
         g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_lane_128_fallback_env_knob():
+    """JUMBO_PALLAS_LANE=128 (the documented escape hatch for TPU
+    generations where Mosaic rejects sub-128 minor dims) must produce the
+    same forward and gradients. LANE is bound at import, so run in a fresh
+    interpreter."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["JUMBO_PALLAS_LANE"] = "128"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(repo) + os.pathsep + env.get("PYTHONPATH", "")
+    code = """
+import jax, jax.numpy as jnp, numpy as np
+jax.config.update('jax_platforms', 'cpu')
+from jumbo_mae_tpu_tpu.ops.pallas import attention as A
+assert A.LANE == 128, A.LANE
+k0 = jax.random.key(0)
+q, k, v = (jax.random.normal(jax.random.fold_in(k0, i), (2, 199, 2, 32), jnp.float32) for i in range(3))
+def ref(q, k, v):
+    p = jax.nn.softmax(jnp.einsum('bqhd,bkhd->bhqk', q, k), -1)
+    return jnp.einsum('bhqk,bkhd->bqhd', p, v)
+def flash(q, k, v):
+    return A.pallas_flash_attention(q, k, v, 128, 128, True)
+np.testing.assert_allclose(np.asarray(flash(q, k, v)), np.asarray(ref(q, k, v)), atol=2e-5)
+g = jax.grad(lambda *a: (flash(*a) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+gr = jax.grad(lambda *a: (ref(*a) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+for a, b in zip(g, gr):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=2e-4)
+print('LANE128-OK')
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "LANE128-OK" in proc.stdout

@@ -354,3 +354,16 @@ def test_load_pretrained_routes_gs_msgpack_to_stream(monkeypatch, mesh):
         "gs://bucket/enc.msgpack", state.params, verbose=False
     )
     assert calls["path"] == "gs://bucket/enc.msgpack"
+
+
+def test_export_file_scheme_gets_mkdir_and_atomic_commit(tmp_path, mesh):
+    """file:// targets are LOCAL: they must keep the parent-mkdir and the
+    tmp+rename commit, not be streamed through open_url."""
+    state, _, _, _ = build(mesh)
+    target = tmp_path / "new_dir" / "p.msgpack"  # parent does not exist yet
+    export_params_msgpack(state.params, f"file://{target}")
+    assert target.exists()
+    restored = import_params_msgpack(str(target))
+    assert len(jax.tree_util.tree_leaves(restored)) == len(
+        jax.tree_util.tree_leaves(state.params)
+    )

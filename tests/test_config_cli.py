@@ -257,3 +257,31 @@ def test_smoke_finetune_resume(tmp_path):
     )
     m2 = train(cfg2)
     assert "val/acc1" in m2
+
+
+def test_gather_pick_cursor_preserves_native_marker(monkeypatch):
+    """The multi-host gather/pick pair must carry the native-IO substrate
+    marker; dropping it would make every pod-scale native resume fail (or
+    worse, mis-resume on the worker path)."""
+    import numpy as np
+
+    from jumbo_mae_tpu_tpu.cli import train as cli_train
+
+    snap = {"workers": [[0, 12]], "batches": 2, "native_threads": 2}
+
+    class FakeMHU:
+        @staticmethod
+        def process_allgather(x):
+            return np.stack([np.asarray(x), np.asarray(x)])
+
+    monkeypatch.setattr(cli_train.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(cli_train.jax, "process_index", lambda: 1)
+    import jax.experimental.multihost_utils as mhu
+
+    monkeypatch.setattr(mhu, "process_allgather", FakeMHU.process_allgather)
+
+    gathered = cli_train._gather_data_cursor(snap)
+    assert gathered["native_threads"] == 2
+    picked = cli_train._pick_process_cursor(gathered)
+    assert picked["native_threads"] == 2
+    assert picked["workers"] == [[0, 12]]

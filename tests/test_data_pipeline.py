@@ -229,16 +229,22 @@ def test_train_loader_cursor_worker_mismatch_raises(shard_dir):
         TrainLoader(cfg, batch_size=8, cursor=snap)
 
 
-def test_native_loader_not_sample_exact_resumable(shard_dir):
-    """The native-IO substrate interleaves shards in thread-dependent order,
-    so it must refuse exact cursors and report none (epoch resume only)."""
+def test_native_loader_snapshot_records_substrate(shard_dir):
+    """The native-IO substrate is sample-exactly resumable (deterministic
+    per-thread shard ownership + round-robin merge, native/tario.cc), but
+    only under the SAME thread count and substrate: a snapshot carries
+    ``native_threads`` and a worker-path cursor is refused.
+    Full resume equality: tests/test_native_loader.py."""
     cfg = _cfg(shard_dir, use_native=True)
-    with pytest.raises(ValueError, match="native"):
+    with pytest.raises(ValueError, match="subprocess-worker"):
         TrainLoader(cfg, batch_size=8, cursor={"workers": [[0, 8]], "batches": 1})
     loader = TrainLoader(cfg, batch_size=8)
     try:
         next(loader)
-        assert loader.snapshot() is None
+        snap = loader.snapshot()
+        assert snap is not None
+        assert snap["native_threads"] == cfg.native_io_threads
+        assert snap["batches"] == 1
     finally:
         loader.close()
 

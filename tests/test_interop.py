@@ -1,5 +1,7 @@
 """Converter tests: exact round-trip + torch-semantics equivalence."""
 
+from pathlib import Path
+
 import jax
 import numpy as np
 import pytest
@@ -97,3 +99,151 @@ def test_patch_embed_conv_semantics(tiny_variables):
         torch.from_numpy(x.transpose(0, 3, 1, 2)), w, stride=p
     ).numpy()
     np.testing.assert_allclose(flax_out[0], t_out[0, :, 0, 0], rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# timm-hub import (--from-timm), with a stubbed hub — no network in tests
+# --------------------------------------------------------------------------
+
+
+def _plain_vit_state(dim=64, heads=4, blocks=2, grid=4, labels=10, seed=0):
+    """A timm-layout plain-ViT state dict (single cls_token, CLS slot baked
+    into pos_embed) sized for preset('vit_t16', image_size=32, patch_size=8)."""
+    rng = np.random.default_rng(seed)
+
+    def r(*shape):
+        return rng.standard_normal(shape).astype(np.float32)
+
+    sd = {
+        "cls_token": r(1, 1, dim),
+        "pos_embed": r(1, 1 + grid * grid, dim),
+        "patch_embed.proj.weight": r(dim, 3, 8, 8),
+        "patch_embed.proj.bias": r(dim),
+        "norm.weight": r(dim),
+        "norm.bias": r(dim),
+        "head.weight": r(labels, dim),
+        "head.bias": r(labels),
+    }
+    for i in range(blocks):
+        p = f"blocks.{i}."
+        sd |= {
+            p + "norm1.weight": r(dim),
+            p + "norm1.bias": r(dim),
+            p + "attn.qkv.weight": r(3 * dim, dim),
+            p + "attn.qkv.bias": r(3 * dim),
+            p + "attn.proj.weight": r(dim, dim),
+            p + "attn.proj.bias": r(dim),
+            p + "norm2.weight": r(dim),
+            p + "norm2.bias": r(dim),
+            p + "mlp.fc1.weight": r(4 * dim, dim),
+            p + "mlp.fc1.bias": r(4 * dim),
+            p + "mlp.fc2.weight": r(dim, 4 * dim),
+            p + "mlp.fc2.bias": r(dim),
+        }
+    return sd
+
+
+def test_timm_adapter_folds_cls_posemb_and_tiles():
+    from jumbo_mae_tpu_tpu.interop import timm_plain_vit_to_jumbo_state
+
+    sd = _plain_vit_state()
+    out = timm_plain_vit_to_jumbo_state(sd, num_cls_tokens=3)
+    want_cls = sd["cls_token"] + sd["pos_embed"][:, :1, :]
+    assert out["cls_tokens"].shape == (1, 3, 64)
+    for k in range(3):
+        np.testing.assert_array_equal(out["cls_tokens"][:, k], want_cls[:, 0])
+    np.testing.assert_array_equal(out["pos_embed"], sd["pos_embed"][:, 1:, :])
+    assert "cls_token" not in out
+    assert not any(k.startswith("jumbo_mlp") for k in out)
+
+
+def test_timm_import_end_to_end_warm_start(tmp_path, monkeypatch):
+    """Stubbed timm hub → CLI to-flax --from-timm → msgpack → warm start into
+    a real jumbo model: pretrained leaves load, the jumbo MLP (which has no
+    timm source) keeps its fresh init."""
+    import sys
+    import types
+
+    import torch
+
+    sd_np = _plain_vit_state()
+
+    class _StubModel:
+        def state_dict(self):
+            return {k: torch.from_numpy(v) for k, v in sd_np.items()}
+
+    stub = types.ModuleType("timm")
+    stub.create_model = lambda name, pretrained=True, **kw: _StubModel()
+    monkeypatch.setitem(sys.modules, "timm", stub)
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import convert_checkpoint
+    finally:
+        sys.path.pop(0)
+    dst = tmp_path / "timm.msgpack"
+    convert_checkpoint.main(
+        ["to-flax", "vit_tiny_stub", str(dst), "--heads", "4", "--from-timm"]
+    )
+
+    from jumbo_mae_tpu_tpu.models import ClassificationModel
+    from jumbo_mae_tpu_tpu.train.checkpoint import load_pretrained_params
+
+    enc = preset(
+        "vit_t16",
+        labels=10,
+        image_size=32,
+        patch_size=8,
+        posemb="learnable",
+        dtype="float32",
+    )
+    model = ClassificationModel(enc)
+    init = model.init(
+        {"params": jax.random.key(0)},
+        np.zeros((1, 32, 32, 3), np.uint8),
+        np.zeros((1,), np.int32),
+    )["params"]
+    merged = load_pretrained_params(str(dst), init, verbose=False)
+
+    got = merged["model"]
+    want_cls = np.tile(
+        sd_np["cls_token"] + sd_np["pos_embed"][:, :1, :], (1, 3, 1)
+    )
+    np.testing.assert_array_equal(np.asarray(got["cls_tokens"]), want_cls)
+    np.testing.assert_array_equal(
+        np.asarray(got["embed"]["proj"]["kernel"]),
+        sd_np["patch_embed.proj.weight"].transpose(2, 3, 1, 0),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got["embed"]["pos_embed"]),
+        sd_np["pos_embed"][0, 1:, :].reshape(4, 4, 64),
+    )
+    # plain head (L, D) → jumbo head (L, K*D): K copies at 1/K, so logits
+    # match the plain model while the CLS slots still agree
+    want_head = np.tile(sd_np["head.weight"] / 3.0, (1, 3)).T
+    np.testing.assert_allclose(
+        np.asarray(got["head"]["fc"]["kernel"]), want_head, rtol=1e-6
+    )
+    # the jumbo MLP has no timm counterpart — fresh init preserved
+    np.testing.assert_array_equal(
+        np.asarray(got["jumbo_mlp"]["fc1"]["kernel"]),
+        np.asarray(init["model"]["jumbo_mlp"]["fc1"]["kernel"]),
+    )
+
+
+def test_timm_adapter_gap_model_without_cls_token():
+    """GAP-pooled timm models (class_token=False) have no cls_token and no
+    CLS slot in pos_embed — the adapter must pass the grid through and omit
+    cls_tokens (fresh init on warm start), not crash."""
+    from jumbo_mae_tpu_tpu.interop import timm_plain_vit_to_jumbo_state
+
+    sd = _plain_vit_state()
+    del sd["cls_token"]
+    sd["pos_embed"] = sd["pos_embed"][:, 1:, :]  # (1, 16, 64) — no CLS slot
+    out = timm_plain_vit_to_jumbo_state(sd, num_cls_tokens=3)
+    np.testing.assert_array_equal(out["pos_embed"], sd["pos_embed"])
+    assert "cls_tokens" not in out and "cls_token" not in out
+    # and the downstream converter tolerates the absent cls_tokens
+    tree = torch_to_flax_params(out, heads=4)
+    assert "cls_tokens" not in tree
+    assert "block_0" in tree and "embed" in tree

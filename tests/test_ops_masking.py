@@ -131,6 +131,7 @@ def test_mask_algebra():
         ),
         [[1.0, 0.0]],
     )
-    np.testing.assert_array_equal(
-        np.asarray(mask_not(jnp.array([[0.3, 0.0]]))), [[0.0, 1.0]]
+    # ...but mask_not is pure 1-x (reference semantics): 0.3 inverts to 0.7
+    np.testing.assert_allclose(
+        np.asarray(mask_not(jnp.array([[0.3, 0.0]]))), [[0.7, 1.0]], rtol=1e-6
     )

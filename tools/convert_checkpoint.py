@@ -9,6 +9,13 @@ the jumbo layout.
     python tools/convert_checkpoint.py to-torch  ckpt.msgpack out.pth
     python tools/convert_checkpoint.py to-torch  runs/x/ckpt   out.pth
     python tools/convert_checkpoint.py to-flax   in.pth out.msgpack --heads 12
+    python tools/convert_checkpoint.py to-flax   vit_base_patch16_224 out.msgpack \
+        --heads 12 --from-timm [--exclude-head]
+
+``--from-timm`` pulls pretrained weights from the timm hub by model name
+(parity: ``/root/reference/scripts/convert_pytorch_to_flax.py:24-51``) and
+adapts the plain-ViT layout into the jumbo one (CLS posemb folded + tiled
+to ``--cls-tokens``; the shared jumbo MLP keeps fresh init on warm start).
 """
 
 from __future__ import annotations
@@ -17,17 +24,34 @@ import argparse
 from pathlib import Path
 
 
-def main():
+def main(argv: list[str] | None = None):
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
     tt = sub.add_parser("to-torch")
     tt.add_argument("src", help=".msgpack params file or Orbax ckpt directory")
     tt.add_argument("dst", help="output .pth path")
     tf = sub.add_parser("to-flax")
-    tf.add_argument("src", help="input .pth state-dict path")
+    tf.add_argument("src", help="input .pth path, or a timm model name with --from-timm")
     tf.add_argument("dst", help="output .msgpack path")
     tf.add_argument("--heads", type=int, required=True, help="attention heads")
-    args = parser.parse_args()
+    tf.add_argument(
+        "--from-timm",
+        action="store_true",
+        help="treat src as a timm model name and pull pretrained hub weights",
+    )
+    tf.add_argument(
+        "--exclude-head",
+        action="store_true",
+        help="with --from-timm: drop the classification head (num_classes=0)",
+    )
+    tf.add_argument(
+        "--cls-tokens",
+        type=int,
+        default=3,
+        help="with --from-timm: tile the plain-ViT CLS token to this many "
+        "jumbo CLS slots (default 3)",
+    )
+    args = parser.parse_args(argv)
 
     import torch
 
@@ -47,12 +71,40 @@ def main():
         torch.save({k: torch.from_numpy(v.copy()) for k, v in state.items()}, args.dst)
         print(f"wrote {len(state)} tensors → {args.dst}")
     else:
-        sd = torch.load(args.src, map_location="cpu", weights_only=True)
-        sd = {k: v.numpy() for k, v in sd.items()}
+        if args.from_timm:
+            sd = load_timm_state_dict(args.src, exclude_head=args.exclude_head)
+            from jumbo_mae_tpu_tpu.interop import timm_plain_vit_to_jumbo_state
+
+            sd = timm_plain_vit_to_jumbo_state(
+                sd, num_cls_tokens=args.cls_tokens
+            )
+        else:
+            sd = torch.load(args.src, map_location="cpu", weights_only=True)
+            sd = {k: v.numpy() for k, v in sd.items()}
         tree = torch_to_flax_params(sd, heads=args.heads)
         tree.pop("__batch_stats__", None)
         export_params_msgpack({"model": tree}, args.dst)
         print(f"wrote flax params → {args.dst}")
+
+
+def load_timm_state_dict(model_name: str, *, exclude_head: bool = False) -> dict:
+    """Pull pretrained weights from the timm hub by model name, as numpy.
+    Kept separate so tests can stub ``timm`` without network access."""
+    try:
+        import timm
+    except ImportError as e:
+        raise SystemExit(
+            "--from-timm needs the `timm` package (and hub network access); "
+            "install it or download the .pth and convert from the file"
+        ) from e
+    model = timm.create_model(
+        model_name,
+        pretrained=True,
+        **({"num_classes": 0} if exclude_head else {}),
+    )
+    return {
+        k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
+    }
 
 
 if __name__ == "__main__":

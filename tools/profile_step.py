@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Per-op device-trace breakdown of one bench train step.
+
+Captures a ``jax.profiler`` trace of the bench step (same builder as
+bench.py, so the profiled program IS the benched program) and aggregates
+device-track op durations by ``hlo_category`` plus the top self-time ops —
+the table PERF.md's "Where a step goes" is built from, as one command:
+
+    python tools/profile_step.py --model vit_h14 --steps 5 --out /tmp/h14
+
+The reference had no profiling surface at all (SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def capture(model: str, steps: int, out_dir: str, batch: int | None) -> str:
+    import jax
+
+    import bench
+
+    if batch is not None:
+        os.environ["BENCH_BATCH"] = str(batch)
+    batch_size = int(
+        os.environ.get("BENCH_BATCH", str(bench.MODELS[model]["batch"]))
+    )
+    step, state, batch_dev, _ = bench.build_step("bfloat16", batch_size, model)
+    for _ in range(3):  # compile + warm
+        state, metrics = step(state, batch_dev)
+    jax.block_until_ready(metrics["loss"])
+
+    jax.profiler.start_trace(out_dir)
+    for _ in range(steps):
+        state, metrics = step(state, batch_dev)
+    jax.block_until_ready(metrics["loss"])
+    jax.profiler.stop_trace()
+
+    traces = glob.glob(
+        os.path.join(out_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    if not traces:
+        raise FileNotFoundError(f"no trace written under {out_dir}")
+    return max(traces, key=os.path.getmtime)
+
+
+def aggregate(trace_path: str, steps: int) -> tuple[dict, list]:
+    """Sum device-track event durations by hlo_category and by op name.
+
+    Device tracks are the pids whose process names mention the accelerator
+    (\"/device:TPU\" etc.); host/python tracks are excluded so the table is
+    chip time, not dispatch time.
+    """
+    with gzip.open(trace_path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+
+    device_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname = e.get("args", {}).get("name", "")
+            if any(t in pname.lower() for t in ("tpu", "gpu", "device", "xla")):
+                if "host" not in pname.lower():
+                    device_pids.add(e["pid"])
+
+    by_cat: dict[str, float] = collections.defaultdict(float)
+    by_op: dict[str, float] = collections.defaultdict(float)
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        dur_ms = e.get("dur", 0) / 1e3 / steps
+        cat = e.get("args", {}).get("hlo_category") or "(uncategorized)"
+        by_cat[cat] += dur_ms
+        by_op[e.get("name", "?")] += dur_ms
+    top_ops = sorted(by_op.items(), key=lambda kv: -kv[1])[:20]
+    return dict(by_cat), top_ops
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="vit_h14")
+    parser.add_argument("--steps", type=int, default=5)
+    parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--out", default="/tmp/profile_step")
+    parser.add_argument(
+        "--trace",
+        default=None,
+        help="skip capture; aggregate an existing .trace.json.gz",
+    )
+    args = parser.parse_args(argv)
+
+    path = args.trace or capture(args.model, args.steps, args.out, args.batch)
+    by_cat, top_ops = aggregate(path, args.steps)
+    total = sum(by_cat.values())
+    print(f"\ndevice time by hlo_category (ms/step, {args.steps} steps):")
+    for cat, ms in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        print(f"  {cat:<28} {ms:8.2f}  {100 * ms / max(total, 1e-9):5.1f}%")
+    print(f"  {'TOTAL':<28} {total:8.2f}")
+    print("\ntop ops by self time (ms/step):")
+    for name, ms in top_ops:
+        print(f"  {ms:8.3f}  {name[:100]}")
+    print(f"\ntrace: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
