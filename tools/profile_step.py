@@ -65,13 +65,33 @@ def aggregate(trace_path: str, steps: int) -> tuple[dict, list]:
         data = json.load(f)
     events = data.get("traceEvents", [])
 
-    device_pids = set()
+    pid_names = {}
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "process_name":
-            pname = e.get("args", {}).get("name", "")
-            if any(t in pname.lower() for t in ("tpu", "gpu", "device", "xla")):
-                if "host" not in pname.lower():
-                    device_pids.add(e["pid"])
+            pid_names[e["pid"]] = e.get("args", {}).get("name", "")
+    device_pids = {
+        pid
+        for pid, pname in pid_names.items()
+        if any(t in pname.lower() for t in ("tpu", "gpu", "device", "xla"))
+        and "host" not in pname.lower()
+    }
+    if not device_pids:
+        # No device track (CPU backend). Prefer tracks whose events carry an
+        # hlo_category (real op events); failing that, fall back to all host
+        # tracks — those spans NEST (parent+child both counted), so totals
+        # overstate wall time and are smoke-test-only.
+        cat_pids = {
+            e["pid"]
+            for e in events
+            if e.get("ph") == "X" and e.get("args", {}).get("hlo_category")
+        }
+        device_pids = cat_pids or set(pid_names)
+        kind = "hlo-op host" if cat_pids else "HOST (nested spans double-count)"
+        print(
+            f"[profile_step] no device track found (tracks: "
+            f"{sorted(pid_names.values())}); aggregating {kind} tracks — "
+            "smoke only, host time != chip time"
+        )
 
     by_cat: dict[str, float] = collections.defaultdict(float)
     by_op: dict[str, float] = collections.defaultdict(float)
