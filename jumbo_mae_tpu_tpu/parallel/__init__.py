@@ -1,4 +1,11 @@
 from jumbo_mae_tpu_tpu.parallel.mesh import MeshConfig, create_mesh
+from jumbo_mae_tpu_tpu.parallel.pipeline import (
+    create_pipeline_mesh,
+    gpipe,
+    pipelined_blocks_apply,
+    stack_block_params,
+    unstack_block_params,
+)
 from jumbo_mae_tpu_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_sharded,
@@ -13,6 +20,11 @@ from jumbo_mae_tpu_tpu.parallel.sharding import (
 __all__ = [
     "MeshConfig",
     "create_mesh",
+    "create_pipeline_mesh",
+    "gpipe",
+    "pipelined_blocks_apply",
+    "stack_block_params",
+    "unstack_block_params",
     "batch_sharding",
     "infer_state_sharding",
     "ring_attention",

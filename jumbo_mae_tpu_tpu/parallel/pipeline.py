@@ -1,0 +1,183 @@
+"""GPipe-style pipeline parallelism over a ``pipe`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.10: PP — NO); its
+ViT sizes fit one chip. This module adds it as a first-class runtime
+capability for depth-sharding larger stacks: transformer blocks are
+stacked along a leading "stage" axis and sharded over the ``pipe`` mesh
+axis — each device owns ``layers / n_stages`` consecutive blocks — and
+microbatches stream through the classic GPipe schedule:
+
+- tick t: stage 0 feeds microbatch t (clamped past the last one), every
+  stage applies its local blocks, activations hop to the next stage with
+  ``lax.ppermute`` (one ICI neighbor hop per tick — the mesh should place
+  ``pipe`` on ICI);
+- after ``microbatches + n_stages − 1`` ticks the last stage has collected
+  every microbatch; a masked ``psum`` replicates the output.
+
+Everything is ``lax.scan``/``ppermute`` inside one ``shard_map`` — a
+single XLA program, fully differentiable (``ppermute`` transposes to the
+reverse hop, so ``jax.grad`` yields the backward pipeline schedule
+automatically). Composes with data parallelism by sharding the microbatch
+batch dim over ``data`` in the same ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_pipeline_mesh(
+    data: int, pipe: int, devices: list | None = None
+) -> Mesh:
+    """(data, pipe) mesh: consecutive devices form a pipeline (ppermute
+    hops ride neighbor ICI links), replicated ``data`` ways."""
+    devices = devices if devices is not None else jax.devices()
+    if data * pipe > len(devices):
+        raise ValueError(
+            f"mesh (data={data}, pipe={pipe}) needs {data * pipe} devices, "
+            f"have {len(devices)}"
+        )
+    dev = np.array(devices[: data * pipe]).reshape(data, pipe)
+    return Mesh(dev, ("data", "pipe"))
+
+
+def stack_block_params(params: dict, prefix: str = "block_") -> tuple[dict, int]:
+    """Stack homogeneous per-block subtrees (``block_0`` … ``block_{L-1}``,
+    the JumboViT/MAE-decoder layout) into one tree with a leading block
+    axis — the form :func:`gpipe` shards over ``pipe``."""
+    names = sorted(
+        (k for k in params if k.startswith(prefix)),
+        key=lambda k: int(k[len(prefix) :]),
+    )
+    if not names:
+        raise ValueError(f"no {prefix}* subtrees in params")
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *[params[n] for n in names]
+    )
+    return stacked, len(names)
+
+
+def unstack_block_params(stacked: dict, prefix: str = "block_") -> dict:
+    """Inverse of :func:`stack_block_params`."""
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    return {
+        f"{prefix}{i}": jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+        for i in range(n)
+    }
+
+
+def gpipe(
+    block_fn: Callable[[dict, jax.Array], jax.Array],
+    stacked_params: dict,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    microbatches: int,
+    axis: str = "pipe",
+    data_axis: str | None = "data",
+) -> jax.Array:
+    """Run ``x`` through all stacked blocks under the GPipe schedule.
+
+    ``block_fn(one_block_params, h) -> h`` must be pure (e.g. a flax
+    ``apply`` with ``deterministic=True``). ``stacked_params`` carries the
+    leading block axis (from :func:`stack_block_params`); the block count
+    must divide by the mesh's ``pipe`` size. ``x`` is the global batch;
+    ``microbatches`` must divide it. Returns the full-batch output,
+    replicated over ``pipe``.
+    """
+    n_stages = mesh.shape[axis]
+    n_blocks = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_blocks % n_stages:
+        raise ValueError(
+            f"{n_blocks} blocks do not divide over {n_stages} pipeline stages"
+        )
+    batch = x.shape[0]
+    if batch % microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible into {microbatches} microbatches"
+        )
+    mb = batch // microbatches
+    xm = x.reshape(microbatches, mb, *x.shape[1:])
+
+    data_spec = data_axis if (data_axis and data_axis in mesh.shape) else None
+    if data_spec and mb % mesh.shape[data_axis]:
+        raise ValueError(
+            f"microbatch size {mb} (batch {batch} / {microbatches} "
+            f"microbatches) does not divide over the "
+            f"{data_axis}={mesh.shape[data_axis]} mesh axis"
+        )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+            P(None, data_spec),
+        ),
+        out_specs=P(None, data_spec),
+        check_vma=False,
+    )
+    def run(local_params, x_local):
+        stage = jax.lax.axis_index(axis)
+        m = x_local.shape[0]
+
+        def apply_stage(h):
+            # each stage applies its contiguous slice of blocks in order
+            def one(h, p):
+                return block_fn(p, h), None
+
+            h, _ = jax.lax.scan(one, h, local_params)
+            return h
+
+        def tick(carry, t):
+            act, buf = carry
+            inp = jnp.where(stage == 0, x_local[jnp.clip(t, 0, m - 1)], act)
+            out = apply_stage(inp)
+            nxt = jax.lax.ppermute(
+                out, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            )
+            out_idx = t - (n_stages - 1)
+            collect = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            slot = jnp.clip(out_idx, 0, m - 1)
+            buf = buf.at[slot].set(jnp.where(collect, out, buf[slot]))
+            return (nxt, buf), None
+
+        buf0 = jnp.zeros_like(x_local)
+        act0 = jnp.zeros_like(x_local[0])
+        (_, buf), _ = jax.lax.scan(
+            tick, (act0, buf0), jnp.arange(microbatches + n_stages - 1)
+        )
+        # only the last stage holds real outputs; masked psum replicates
+        mine = jnp.where(stage == n_stages - 1, buf, jnp.zeros_like(buf))
+        return jax.lax.psum(mine, axis)
+
+    out = run(stacked_params, xm)
+    return out.reshape(batch, *x.shape[1:])
+
+
+def pipelined_blocks_apply(
+    block_module,
+    params: dict,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    microbatches: int,
+    prefix: str = "block_",
+) -> jax.Array:
+    """Convenience wrapper: run a model's ``block_*`` chain (e.g. the MAE
+    decoder's :class:`~jumbo_mae_tpu_tpu.models.layers.PlainBlock` stack)
+    through :func:`gpipe`, taking the ordinary (unstacked) param layout."""
+    stacked, _ = stack_block_params(params, prefix)
+
+    def block_fn(p, h):
+        return block_module.apply({"params": p}, h, True)
+
+    return gpipe(
+        block_fn, stacked, x, mesh=mesh, microbatches=microbatches
+    )

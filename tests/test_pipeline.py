@@ -1,0 +1,154 @@
+"""Pipeline parallelism (GPipe over a ``pipe`` mesh axis): the pipelined
+block chain must equal the sequential one — forward AND gradients — under
+every stage/microbatch split, composed with data parallelism. (Beyond the
+reference: SURVEY §2.10 lists PP as absent there.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.models.config import JumboViTConfig
+from jumbo_mae_tpu_tpu.models.layers import PlainBlock
+from jumbo_mae_tpu_tpu.parallel import (
+    create_pipeline_mesh,
+    gpipe,
+    pipelined_blocks_apply,
+    stack_block_params,
+    unstack_block_params,
+)
+
+CFG = JumboViTConfig(layers=4, dim=32, heads=2, dtype="float32")
+BLOCK = PlainBlock(CFG)
+N_BLOCKS, BATCH, SEQ = 4, 8, 12
+
+
+@pytest.fixture(scope="module")
+def chain(devices):
+    """4 PlainBlocks' params (under block_0..block_3) + an input batch."""
+    x = jax.random.normal(jax.random.key(0), (BATCH, SEQ, CFG.dim))
+    params = {}
+    for i in range(N_BLOCKS):
+        params[f"block_{i}"] = BLOCK.init(
+            jax.random.key(10 + i), x, True
+        )["params"]
+    return params, x
+
+
+def sequential(params, x):
+    for i in range(N_BLOCKS):
+        x = BLOCK.apply({"params": params[f"block_{i}"]}, x, True)
+    return x
+
+
+def test_stack_roundtrip(chain):
+    params, _ = chain
+    stacked, n = stack_block_params(params)
+    assert n == N_BLOCKS
+    back = unstack_block_params(stacked)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(back)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("pipe,microbatches", [(2, 4), (4, 2), (4, 8), (2, 1)])
+def test_gpipe_forward_matches_sequential(chain, pipe, microbatches):
+    params, x = chain
+    mesh = create_pipeline_mesh(data=1, pipe=pipe)
+    want = sequential(params, x)
+    got = pipelined_blocks_apply(
+        BLOCK, params, x, mesh=mesh, microbatches=microbatches
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_gpipe_composes_with_data_parallel(chain):
+    params, x = chain
+    mesh = create_pipeline_mesh(data=2, pipe=4)
+    got = pipelined_blocks_apply(BLOCK, params, x, mesh=mesh, microbatches=2)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(sequential(params, x)), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_gpipe_gradients_match_sequential(chain):
+    """ppermute transposes to the reverse hop, so jax.grad through the
+    schedule IS the backward pipeline — it must equal sequential grads."""
+    params, x = chain
+    mesh = create_pipeline_mesh(data=1, pipe=4)
+    stacked, _ = stack_block_params(params)
+
+    def block_fn(p, h):
+        return BLOCK.apply({"params": p}, h, True)
+
+    def loss_pipe(stacked_p):
+        out = gpipe(block_fn, stacked_p, x, mesh=mesh, microbatches=4)
+        return (out**2).mean()
+
+    def loss_seq(stacked_p):
+        h = x
+        for i in range(N_BLOCKS):
+            h = block_fn(jax.tree_util.tree_map(lambda l, i=i: l[i], stacked_p), h)
+        return (h**2).mean()
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_pipe), jax.tree_util.tree_leaves(g_seq)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+
+
+def test_gpipe_jits_as_one_training_step(chain):
+    """value_and_grad of a pipelined loss under jit — one XLA program, the
+    shape the multichip dryrun certifies."""
+    params, x = chain
+    mesh = create_pipeline_mesh(data=2, pipe=4)
+    stacked, _ = stack_block_params(params)
+
+    def block_fn(p, h):
+        return BLOCK.apply({"params": p}, h, True)
+
+    @jax.jit
+    def step(stacked_p):
+        def loss(sp):
+            out = gpipe(block_fn, sp, x, mesh=mesh, microbatches=4)
+            return (out**2).mean()
+
+        return jax.value_and_grad(loss)(stacked_p)
+
+    val, grads = step(stacked)
+    assert np.isfinite(float(val))
+    assert all(
+        np.isfinite(np.asarray(g)).all()
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+
+
+def test_gpipe_validates_divisibility(chain):
+    params, x = chain
+    mesh = create_pipeline_mesh(data=1, pipe=4)
+    stacked, _ = stack_block_params(params)
+
+    def block_fn(p, h):
+        return h
+
+    with pytest.raises(ValueError, match="microbatches"):
+        gpipe(block_fn, stacked, x, mesh=mesh, microbatches=3)
+    mesh3 = create_pipeline_mesh(data=1, pipe=3)
+    with pytest.raises(ValueError, match="stages"):
+        gpipe(block_fn, stacked, x, mesh=mesh3, microbatches=2)
+
+
+def test_gpipe_validates_microbatch_vs_data_axis(chain):
+    params, x = chain
+    stacked, _ = stack_block_params(params)
+    # data=8 can't split the size-2 microbatches of an 8-batch/4-microbatch run
+    mesh = create_pipeline_mesh(data=8, pipe=1)
+    with pytest.raises(ValueError, match="does not divide over the data"):
+        gpipe(lambda p, h: h, stacked, x, mesh=mesh, microbatches=4)
