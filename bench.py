@@ -181,7 +181,15 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
             "BENCH_REMAT_POLICY", spec.get("remat_policy", "none")
         ),
     )
-    dec = DecoderConfig(**spec["dec"], dtype=dtype)
+    # decoder-side remat is its own experiment axis (the decoder runs seq
+    # 199 at head_dim 32 and is un-rematerialized by default)
+    dec_remat = os.environ.get("BENCH_DEC_REMAT_POLICY")
+    dec = DecoderConfig(
+        **spec["dec"],
+        dtype=dtype,
+        grad_ckpt=bool(dec_remat),
+        remat_policy=dec_remat or "none",
+    )
     module = MAEPretrainModel(enc, dec, norm_pix_loss=True)
 
     batch = {

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""A/B matrix runner over bench.py env knobs.
+
+Runs ``bench.py`` once per configuration (cartesian product of the swept
+env knobs), one subprocess each — fresh backend, no cross-run state — and
+appends every result line to a JSONL log with its knobs attached. This is
+how PERF.md A/B tables are produced without babysitting:
+
+    python tools/ab_bench.py --model vit_h14 \
+        --sweep BENCH_DEC_REMAT_POLICY=,dots \
+        --sweep BENCH_BATCH=64,96 \
+        --sweep BENCH_MU_DTYPE=,bfloat16 \
+        --skip-baseline --out /tmp/h14_ab.jsonl
+
+Each --sweep is KNOB=v1,v2,... (empty string = unset). Failed runs are
+recorded with their error line (bench.py emits machine-readable JSON even
+on failure) and the sweep continues.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def parse_sweep(spec: str) -> tuple[str, list[str]]:
+    knob, _, values = spec.partition("=")
+    if not knob or not _:
+        raise SystemExit(f"bad --sweep {spec!r}; expected KNOB=v1,v2,...")
+    return knob, values.split(",")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="vit_h14")
+    parser.add_argument(
+        "--sweep", action="append", default=[], help="KNOB=v1,v2,... (repeatable)"
+    )
+    parser.add_argument("--iters", type=int, default=None)
+    parser.add_argument("--skip-baseline", action="store_true")
+    parser.add_argument("--out", default=None, help="JSONL log path")
+    parser.add_argument(
+        "--timeout", type=float, default=1800, help="per-run seconds"
+    )
+    args = parser.parse_args(argv)
+
+    sweeps = [parse_sweep(s) for s in args.sweep] or [("_", [""])]
+    out_path = Path(args.out or f"/tmp/ab_{args.model}.jsonl")
+
+    combos = list(itertools.product(*(vals for _, vals in sweeps)))
+    print(f"[ab_bench] {len(combos)} configurations → {out_path}")
+    results = []
+    for combo in combos:
+        env = dict(os.environ)
+        env["BENCH_MODEL"] = args.model
+        if args.iters is not None:
+            env["BENCH_ITERS"] = str(args.iters)
+        if args.skip_baseline:
+            env["BENCH_SKIP_BASELINE"] = "1"
+        setting = {}
+        for (knob, _), value in zip(sweeps, combo):
+            if knob == "_":
+                continue
+            setting[knob] = value
+            if value == "":
+                env.pop(knob, None)
+            else:
+                env[knob] = value
+        label = " ".join(f"{k}={v or '<unset>'}" for k, v in setting.items())
+        print(f"[ab_bench] run: {label or '(defaults)'}", flush=True)
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, str(REPO / "bench.py")],
+                env=env,
+                cwd=str(REPO),
+                capture_output=True,
+                text=True,
+                timeout=args.timeout,
+            )
+            lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+            try:
+                parsed = json.loads(lines[-1]) if lines else None
+            except json.JSONDecodeError:
+                parsed = None
+            record = {
+                "knobs": setting,
+                "rc": proc.returncode,
+                "wall_s": round(time.monotonic() - t0, 1),
+                "result": parsed,
+            }
+            if proc.returncode != 0 and parsed is None:
+                record["stderr_tail"] = proc.stderr[-400:]
+        except subprocess.TimeoutExpired:
+            record = {
+                "knobs": setting,
+                "rc": "timeout",
+                "wall_s": round(time.monotonic() - t0, 1),
+                "result": None,
+            }
+        results.append(record)
+        with out_path.open("a") as f:
+            f.write(json.dumps(record) + "\n")
+        val = (record.get("result") or {}).get("value")
+        print(f"[ab_bench]   → rc={record['rc']} value={val}", flush=True)
+
+    # a failed run's error JSON can still carry the partial bf16-leg value —
+    # only rc==0 rows count as successes
+    ok = [
+        r
+        for r in results
+        if r["rc"] == 0 and (r.get("result") or {}).get("value")
+    ]
+    if ok:
+        best = max(ok, key=lambda r: r["result"]["value"])
+        print(
+            f"[ab_bench] best: {best['result']['value']} "
+            f"({best['result'].get('unit', '')}) with {best['knobs']}"
+        )
+    return 0 if ok or not combos else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
