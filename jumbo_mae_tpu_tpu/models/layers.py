@@ -150,6 +150,20 @@ class Mlp(nn.Module):
         return nn.Dropout(self.dropout)(x, deterministic)
 
 
+def make_jumbo_mlp(cfg: JumboViTConfig, name: str | None = "jumbo_mlp") -> Mlp:
+    """The shared jumbo CLS MLP's one architectural definition — used by
+    :class:`~jumbo_mae_tpu_tpu.models.vit.JumboViT` (owner of the shared
+    params) and by the pipeline-parallel runtime, so the two can never
+    diverge."""
+    return Mlp(
+        dim=cfg.num_cls_tokens * cfg.dim,
+        hidden_dim=4 * cfg.num_cls_tokens * cfg.dim,
+        dropout=cfg.dropout,
+        dtype=cfg.compute_dtype,
+        name=name,
+    )
+
+
 class DropPath(nn.Module):
     """Stochastic depth: drop the whole residual branch per sample, i.e. a
     Dropout broadcast over every non-batch axis (the reference's idiom,

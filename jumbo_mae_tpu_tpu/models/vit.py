@@ -31,8 +31,8 @@ from jumbo_mae_tpu_tpu.models.config import JumboViTConfig, maybe_remat
 from jumbo_mae_tpu_tpu.models.layers import (
     ClassifierHead,
     JumboBlock,
-    Mlp,
     PatchEmbed,
+    make_jumbo_mlp,
 )
 from jumbo_mae_tpu_tpu.ops.masking import random_masking
 
@@ -46,13 +46,7 @@ class JumboViT(nn.Module):
         self.cls_tokens = self.param(
             "cls_tokens", init.zeros, (1, cfg.num_cls_tokens, cfg.dim)
         )
-        self.jumbo_mlp = Mlp(
-            dim=cfg.num_cls_tokens * cfg.dim,
-            hidden_dim=4 * cfg.num_cls_tokens * cfg.dim,
-            dropout=cfg.dropout,
-            dtype=cfg.compute_dtype,
-            name="jumbo_mlp",
-        )
+        self.jumbo_mlp = make_jumbo_mlp(cfg)
         block_cls = maybe_remat(JumboBlock, cfg)
         self.blocks = [
             block_cls(cfg, self.jumbo_mlp, name=f"block_{i}")

@@ -3,6 +3,7 @@ from jumbo_mae_tpu_tpu.parallel.pipeline import (
     create_pipeline_mesh,
     gpipe,
     pipelined_blocks_apply,
+    pipelined_jumbo_blocks_apply,
     stack_block_params,
     unstack_block_params,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "create_pipeline_mesh",
     "gpipe",
     "pipelined_blocks_apply",
+    "pipelined_jumbo_blocks_apply",
     "stack_block_params",
     "unstack_block_params",
     "batch_sharding",

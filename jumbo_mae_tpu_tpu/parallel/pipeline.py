@@ -73,7 +73,7 @@ def unstack_block_params(stacked: dict, prefix: str = "block_") -> dict:
 
 
 def gpipe(
-    block_fn: Callable[[dict, jax.Array], jax.Array],
+    block_fn: Callable[..., jax.Array],
     stacked_params: dict,
     x: jax.Array,
     *,
@@ -81,6 +81,7 @@ def gpipe(
     microbatches: int,
     axis: str = "pipe",
     data_axis: str | None = "data",
+    shared_params: dict | None = None,
 ) -> jax.Array:
     """Run ``x`` through all stacked blocks under the GPipe schedule.
 
@@ -90,6 +91,13 @@ def gpipe(
     must divide by the mesh's ``pipe`` size. ``x`` is the global batch;
     ``microbatches`` must divide it. Returns the full-batch output,
     replicated over ``pipe``.
+
+    ``shared_params`` (optional) is a param tree used by EVERY block — the
+    jumbo architecture's shared CLS MLP is exactly this shape. It is
+    replicated across stages, ``block_fn`` is then called as
+    ``block_fn(one_block_params, h, shared_params)``, and its gradient
+    comes back correctly summed over stages (the replicated-input
+    transpose is a ``psum`` over ``pipe``).
     """
     n_stages = mesh.shape[axis]
     n_blocks = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
@@ -113,24 +121,29 @@ def gpipe(
             f"{data_axis}={mesh.shape[data_axis]} mesh axis"
         )
 
+    shared = {} if shared_params is None else shared_params
+
     @partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(
             jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
             P(None, data_spec),
+            jax.tree_util.tree_map(lambda _: P(), shared),  # replicated
         ),
         out_specs=P(None, data_spec),
         check_vma=False,
     )
-    def run(local_params, x_local):
+    def run(local_params, x_local, shared_local):
         stage = jax.lax.axis_index(axis)
         m = x_local.shape[0]
 
         def apply_stage(h):
             # each stage applies its contiguous slice of blocks in order
             def one(h, p):
-                return block_fn(p, h), None
+                if shared_params is None:
+                    return block_fn(p, h), None
+                return block_fn(p, h, shared_local), None
 
             h, _ = jax.lax.scan(one, h, local_params)
             return h
@@ -157,8 +170,46 @@ def gpipe(
         mine = jnp.where(stage == n_stages - 1, buf, jnp.zeros_like(buf))
         return jax.lax.psum(mine, axis)
 
-    out = run(stacked_params, xm)
+    out = run(stacked_params, xm, shared)
     return out.reshape(batch, *x.shape[1:])
+
+
+def pipelined_jumbo_blocks_apply(
+    cfg,
+    encoder_params: dict,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    microbatches: int,
+) -> jax.Array:
+    """Pipeline a JumboViT encoder's ``block_*`` chain, with the shared
+    jumbo CLS MLP replicated across stages.
+
+    ``encoder_params`` is the encoder subtree of a real model
+    (``block_0…block_{L-1}`` + ``jumbo_mlp`` + embed/ln/… — only the
+    blocks and ``jumbo_mlp`` are read). ``x`` is the token sequence after
+    embedding/CLS concat, i.e. the input to ``block_0``.
+    """
+    from jumbo_mae_tpu_tpu.models.layers import JumboBlock, make_jumbo_mlp
+
+    # name=None: a standalone block scopes the shared MLP under itself
+    # via its attribute name, and we graft the shared params in per call
+    block = JumboBlock(cfg, make_jumbo_mlp(cfg, name=None))
+    stacked, _ = stack_block_params(encoder_params)
+
+    def block_fn(p, h, shared):
+        # a standalone JumboBlock scopes the shared MLP under itself; the
+        # encoder scopes it at the parent — graft it in per call
+        return block.apply({"params": {**p, "jumbo_mlp": shared}}, h, True)
+
+    return gpipe(
+        block_fn,
+        stacked,
+        x,
+        mesh=mesh,
+        microbatches=microbatches,
+        shared_params=encoder_params["jumbo_mlp"],
+    )
 
 
 def pipelined_blocks_apply(

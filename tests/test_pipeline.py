@@ -152,3 +152,60 @@ def test_gpipe_validates_microbatch_vs_data_axis(chain):
     mesh = create_pipeline_mesh(data=8, pipe=1)
     with pytest.raises(ValueError, match="does not divide over the data"):
         gpipe(lambda p, h: h, stacked, x, mesh=mesh, microbatches=4)
+
+
+def test_gpipe_shared_params_jumbo_blocks(devices):
+    """The signature JumboBlock chain — shared CLS MLP across every block —
+    pipelines correctly: forward equals sequential, and the shared MLP's
+    gradient comes back as the sum over stages (replicated-input psum)."""
+    from jumbo_mae_tpu_tpu.models.layers import JumboBlock, Mlp
+    from jumbo_mae_tpu_tpu.parallel import pipelined_jumbo_blocks_apply
+
+    cfg = JumboViTConfig(
+        layers=4, dim=32, heads=2, num_cls_tokens=3, dtype="float32"
+    )
+    k = cfg.num_cls_tokens
+    jm = Mlp(k * cfg.dim, 4 * k * cfg.dim, 0.0, cfg.compute_dtype)
+    block = JumboBlock(cfg, jm)
+    x = jax.random.normal(jax.random.key(0), (8, k + 9, cfg.dim))
+
+    v0 = block.init(jax.random.key(1), x, True)["params"]
+    shared = v0.pop("jumbo_mlp")
+    enc_params = {"jumbo_mlp": shared, "block_0": v0}
+    for i in range(1, 4):
+        vi = block.init(jax.random.key(1 + i), x, True)["params"]
+        vi.pop("jumbo_mlp")
+        enc_params[f"block_{i}"] = vi
+
+    def sequential(params, x):
+        h = x
+        for i in range(4):
+            h = block.apply(
+                {"params": {**params[f"block_{i}"], "jumbo_mlp": params["jumbo_mlp"]}},
+                h,
+                True,
+            )
+        return h
+
+    mesh = create_pipeline_mesh(data=2, pipe=4)
+    got = pipelined_jumbo_blocks_apply(
+        cfg, enc_params, x, mesh=mesh, microbatches=4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(sequential(enc_params, x)), rtol=2e-5, atol=2e-5
+    )
+
+    # gradients, incl. the shared MLP's (summed over stages)
+    g_pipe = jax.grad(
+        lambda p: (
+            pipelined_jumbo_blocks_apply(cfg, p, x, mesh=mesh, microbatches=4)
+            ** 2
+        ).mean()
+    )(enc_params)
+    g_seq = jax.grad(lambda p: (sequential(p, x) ** 2).mean())(enc_params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_pipe), jax.tree_util.tree_leaves(g_seq)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
+        )

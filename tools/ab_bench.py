@@ -53,6 +53,13 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     sweeps = [parse_sweep(s) for s in args.sweep] or [("_", [""])]
+    knob_names = [k for k, _ in sweeps]
+    dupes = {k for k in knob_names if knob_names.count(k) > 1}
+    if dupes:
+        raise SystemExit(
+            f"knob(s) {sorted(dupes)} swept more than once — merge the "
+            "values into one --sweep KNOB=v1,v2,..."
+        )
     out_path = Path(args.out or f"/tmp/ab_{args.model}.jsonl")
 
     combos = list(itertools.product(*(vals for _, vals in sweeps)))
@@ -99,12 +106,21 @@ def main(argv: list[str] | None = None) -> int:
             }
             if proc.returncode != 0 and parsed is None:
                 record["stderr_tail"] = proc.stderr[-400:]
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            def _tail(buf):
+                if not buf:
+                    return ""
+                s = buf if isinstance(buf, str) else buf.decode(errors="replace")
+                return s[-400:]
+
             record = {
                 "knobs": setting,
                 "rc": "timeout",
                 "wall_s": round(time.monotonic() - t0, 1),
                 "result": None,
+                # how far it got before the fuse — don't make reruns blind
+                "stdout_tail": _tail(e.stdout),
+                "stderr_tail": _tail(e.stderr),
             }
         results.append(record)
         with out_path.open("a") as f:
