@@ -396,11 +396,11 @@ class _Worker:
         import sys
         import threading
 
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"  # belt and braces; workers never use jax
-        # and don't register remote-accelerator PJRT plugins in them either:
-        # a wedged tunnel must never be able to touch data-worker startup
-        env.pop("PALLAS_AXON_POOL_IPS", None)
+        from jumbo_mae_tpu_tpu.utils.procenv import cpu_subprocess_env
+
+        # workers never use jax, and a wedged accelerator tunnel must never
+        # be able to touch their startup (see utils/procenv.py)
+        env = cpu_subprocess_env()
         repo_root = str(Path(__file__).resolve().parent.parent.parent)
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         self.proc = subprocess.Popen(

@@ -1,0 +1,51 @@
+"""Environment construction for CPU-only subprocesses.
+
+One copy of the round-3 lesson: a wedged remote-accelerator tunnel can
+block ANY process that lets the accelerator PJRT plugin register and then
+touches ``jax.devices()`` — ``JAX_PLATFORMS=cpu`` in the env is NOT enough
+on its own, because the plugin's backend hook intercepts device lookup
+regardless of platform. CPU-only children (data workers, test
+subprocesses, dryruns) must therefore strip the registration variable
+entirely. ``__graft_entry__`` keeps a private copy of this logic on
+purpose — it is a driver-facing standalone script that must not depend on
+package imports in the calling process. (``bench.py`` is different: its
+probe subprocess deliberately keeps the CURRENT env, because it is asking
+whether the real accelerator answers.)
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def cpu_subprocess_env(
+    n_devices: int | None = None,
+    *,
+    compile_cache: str | os.PathLike | None = None,
+    base: dict | None = None,
+) -> dict:
+    """Env for a child process that must run on the CPU backend only.
+
+    - strips the remote-accelerator PJRT registration (see module doc);
+    - forces ``JAX_PLATFORMS=cpu``;
+    - with ``n_devices``, pins ``--xla_force_host_platform_device_count``
+      (replacing any inherited value);
+    - with ``compile_cache``, wires the persistent compile cache with the
+      same knobs as ``tests/conftest.py``.
+    """
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    if n_devices:
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags).strip()
+    if compile_cache:
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", str(compile_cache))
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.25")
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    return env

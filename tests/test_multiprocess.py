@@ -60,11 +60,9 @@ def _free_port() -> int:
 
 
 def test_two_process_train_and_eval_match_single_process(shards, tmp_path):
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env["JAX_COMPILATION_CACHE_DIR"] = str(REPO / ".jax_cache")
+    from jumbo_mae_tpu_tpu.utils.procenv import cpu_subprocess_env
+
+    env = cpu_subprocess_env(2, compile_cache=REPO / ".jax_cache")
     env["PYTHONPATH"] = f"{REPO}:{Path(__file__).parent}"
 
     port = _free_port()

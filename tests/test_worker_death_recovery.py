@@ -57,20 +57,9 @@ def _write_shards(root: Path, n_shards: int = 2, per_shard: int = 32) -> int:
 
 
 def _cli_env() -> dict:
-    env = dict(os.environ)
-    # skip the remote-accelerator PJRT registration entirely: with a wedged
-    # tunnel its backend hook can block even a JAX_PLATFORMS=cpu process
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = [
-        f
-        for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
-    ]
-    env["XLA_FLAGS"] = " ".join(
-        flags + ["--xla_force_host_platform_device_count=8"]
-    )
-    env["JAX_COMPILATION_CACHE_DIR"] = str(REPO / ".jax_cache")
+    from jumbo_mae_tpu_tpu.utils.procenv import cpu_subprocess_env
+
+    env = cpu_subprocess_env(8, compile_cache=REPO / ".jax_cache")
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     return env
 

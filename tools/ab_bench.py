@@ -52,7 +52,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    sweeps = [parse_sweep(s) for s in args.sweep] or [("_", [""])]
+    sweeps = [parse_sweep(s) for s in args.sweep]
     knob_names = [k for k, _ in sweeps]
     dupes = {k for k in knob_names if knob_names.count(k) > 1}
     if dupes:
@@ -62,6 +62,7 @@ def main(argv: list[str] | None = None) -> int:
         )
     out_path = Path(args.out or f"/tmp/ab_{args.model}.jsonl")
 
+    # no sweeps → one run at the defaults (product of zero iterables = [()])
     combos = list(itertools.product(*(vals for _, vals in sweeps)))
     print(f"[ab_bench] {len(combos)} configurations → {out_path}")
     results = []
@@ -74,8 +75,6 @@ def main(argv: list[str] | None = None) -> int:
             env["BENCH_SKIP_BASELINE"] = "1"
         setting = {}
         for (knob, _), value in zip(sweeps, combo):
-            if knob == "_":
-                continue
             setting[knob] = value
             if value == "":
                 env.pop(knob, None)
