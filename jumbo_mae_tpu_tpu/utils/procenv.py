@@ -36,14 +36,14 @@ def cpu_subprocess_env(
     env = dict(os.environ if base is None else base)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    flags = [
-        f
-        for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
-    ]
     if n_devices:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
         flags.append(f"--xla_force_host_platform_device_count={n_devices}")
-    env["XLA_FLAGS"] = " ".join(flags).strip()
+        env["XLA_FLAGS"] = " ".join(flags).strip()
     if compile_cache:
         env.setdefault("JAX_COMPILATION_CACHE_DIR", str(compile_cache))
         env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.25")

@@ -14,6 +14,8 @@ import sys
 import time
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 
@@ -78,3 +80,34 @@ def test_watchdog_converts_hang_into_json_error():
     assert rc == 1, (out, err)
     line = _last_json_line(out)
     assert "error" in line and "watchdog" in line["error"]
+
+
+@pytest.mark.slow
+def test_bench_success_path_on_cpu():
+    """The bench machinery end-to-end on the CPU backend (smoke model, no
+    baseline leg): one valid JSON success line, rc 0. Keeps the success
+    path from rotting between on-chip rounds."""
+    from jumbo_mae_tpu_tpu.utils.procenv import cpu_subprocess_env
+
+    env = cpu_subprocess_env(1, compile_cache=REPO / ".jax_cache")
+    env.update(
+        {
+            "BENCH_MODEL": "vit_t16",
+            "BENCH_ITERS": "2",
+            "BENCH_SKIP_BASELINE": "1",
+        }
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env=env,
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-800:]
+    line = _last_json_line(proc.stdout)
+    assert "error" not in line
+    assert line["metric"].startswith("mae_vit_t16")
+    assert line["value"] and line["value"] > 0
+    assert line["ms_step_bf16"] > 0
