@@ -180,6 +180,9 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
         remat_policy=os.environ.get(
             "BENCH_REMAT_POLICY", spec.get("remat_policy", "none")
         ),
+        # masking gather lowering: "take" (XLA gather) vs "onehot" (MXU
+        # matmul, concat-free unshuffle) — bit-identical, A/B by profile
+        gather_impl=os.environ.get("BENCH_GATHER_IMPL", "take"),
     )
     # decoder-side remat is its own experiment axis (the decoder runs seq
     # 199 at head_dim 32 and is un-rematerialized by default)

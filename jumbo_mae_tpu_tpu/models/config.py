@@ -18,6 +18,7 @@ Posemb = Literal["learnable", "sincos2d"]
 Pooling = Literal["cls", "gap"]
 AttnImpl = Literal["einsum", "flash", "ring", "auto"]
 MaskModeT = Literal["shared", "per_sample"]
+GatherImplT = Literal["take", "onehot"]
 # rematerialization policy under grad_ckpt=True:
 #   "none"          — save nothing, recompute the whole block (max memory win)
 #   "dots"          — save every matmul output, recompute elementwise only
@@ -92,6 +93,10 @@ class JumboViTConfig:
     # TPU-first knobs
     dtype: str = "bfloat16"  # compute dtype; params always float32
     attn_impl: AttnImpl = "auto"
+    # masking shuffle/unshuffle lowering: "take" (XLA dynamic gather) or
+    # "onehot" (0/1 MXU matmul, concat-free unshuffle) — bit-identical
+    # numerics, pick by profile (ops/masking.py validates the value)
+    gather_impl: GatherImplT = "take"
 
     @property
     def head_dim(self) -> int:

@@ -118,7 +118,9 @@ class MAEPretrainModel(nn.Module):
         tokens = self.decoder_proj(tokens)
         cls, visible = tokens[:, :k, :], tokens[:, k:, :]
 
-        full = unshuffle_with_mask_tokens(visible, self.mask_token, ids_restore)
+        full = unshuffle_with_mask_tokens(
+            visible, self.mask_token, ids_restore, impl=enc_cfg.gather_impl
+        )
         decoded = self.decoder(
             jnp.concatenate([cls, full], axis=1), deterministic
         )
