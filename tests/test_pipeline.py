@@ -209,3 +209,38 @@ def test_gpipe_shared_params_jumbo_blocks(devices):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
         )
+
+
+def test_gpipe_composes_with_remat_blocks(chain):
+    """Depth-sharding and rematerialization together — the big-model
+    configuration — must still match the sequential chain's gradients."""
+    from jumbo_mae_tpu_tpu.models.config import maybe_remat
+
+    params, x = chain
+    remat_cfg = CFG.replace(grad_ckpt=True, remat_policy="dots")
+    remat_block = maybe_remat(PlainBlock, remat_cfg)(remat_cfg)
+    mesh = create_pipeline_mesh(data=1, pipe=4)
+    stacked, _ = stack_block_params(params)
+
+    def block_fn(p, h):
+        return remat_block.apply({"params": p}, h, True)
+
+    def loss_pipe(sp):
+        return (
+            gpipe(block_fn, sp, x, mesh=mesh, microbatches=4) ** 2
+        ).mean()
+
+    def loss_seq(sp):
+        h = x
+        for i in range(N_BLOCKS):
+            h = block_fn(jax.tree_util.tree_map(lambda l, i=i: l[i], sp), h)
+        return (h**2).mean()
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(stacked)
+    g_seq = jax.jit(jax.grad(loss_seq))(stacked)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_pipe), jax.tree_util.tree_leaves(g_seq)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
