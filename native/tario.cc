@@ -124,6 +124,39 @@ int64_t parse_octal(const char* p, size_t n) {
   return v;
 }
 
+// tar size field: octal, or GNU base-256 (high bit of byte 0 set) for
+// members >= 8 GiB written by GNU tar
+int64_t parse_size_field(const char* p, size_t n) {
+  if (n > 0 && (unsigned char)p[0] & 0x80) {
+    int64_t v = (unsigned char)p[0] & 0x7f;
+    for (size_t i = 1; i < n; ++i) v = (v << 8) | (unsigned char)p[i];
+    return v;
+  }
+  return parse_octal(p, n);
+}
+
+// read exactly n bytes; false on short read (truncated stream)
+bool read_fully(Stream& in, void* buf, size_t n) {
+  size_t got = 0;
+  char* p = static_cast<char*>(buf);
+  while (got < n) {
+    size_t r = in.read(p + got, n - got);
+    if (r == 0) return false;
+    got += r;
+  }
+  return true;
+}
+
+bool skip_bytes(Stream& in, int64_t n) {
+  char buf[4096];
+  while (n > 0) {
+    size_t r = in.read(buf, n > 4096 ? 4096 : (size_t)n);
+    if (r == 0) return false;
+    n -= (int64_t)r;
+  }
+  return true;
+}
+
 bool is_zero_block(const char* b) {
   for (int i = 0; i < 512; ++i)
     if (b[i]) return false;
@@ -182,8 +215,9 @@ void reader_main(Handle* h, size_t tid) {
 
     std::string cur_stem;
     Sample* cur = nullptr;
-    bool aborted = false;
-    while (!aborted) {
+    std::string pending_name;  // from a PAX 'x' / GNU 'L' header
+    int64_t pending_size = -1;  // from a PAX "size=" record (>= 8 GiB members)
+    for (;;) {  // breaks on end-of-archive or truncation (partial sample still flushes below)
       if (in.read(header, 512) != 512) break;
       if (is_zero_block(header)) break;  // end-of-archive marker
       // ustar: name at 0 (100), size at 124 (12), typeflag at 156,
@@ -193,35 +227,57 @@ void reader_main(Handle* h, size_t tid) {
         std::string prefix(header + 345, strnlen(header + 345, 155));
         name = prefix + "/" + name;
       }
-      int64_t size = parse_octal(header + 124, 12);
+      int64_t size = parse_size_field(header + 124, 12);
       char type = header[156];
+
+      // PAX 'x' / GNU 'L' headers carry the REAL path (and, for >= 8 GiB
+      // members, the real size) of the next member (python tarfile writes
+      // PAX by default): the ustar fields are then truncated/zeroed, and
+      // using them would mis-group samples or desync the stream. Parse
+      // instead of skipping.
+      if ((type == 'x' || type == 'L') && size >= 0 && size <= (1 << 20)) {
+        std::string payload((size_t)size, '\0');
+        if (!read_fully(in, payload.data(), (size_t)size)) break;
+        if (!skip_bytes(in, ((size + 511) & ~511LL) - size)) break;
+        if (type == 'L') {
+          pending_name.assign(payload.c_str());  // NUL-terminated full name
+        } else {
+          // PAX records: "<len> key=value\n"; len covers the whole record
+          size_t pos = 0;
+          while (pos < payload.size()) {
+            size_t sp = payload.find(' ', pos);
+            if (sp == std::string::npos) break;
+            long rec_len = strtol(payload.c_str() + pos, nullptr, 10);
+            if (rec_len <= 0 || pos + (size_t)rec_len > payload.size()) break;
+            std::string rec = payload.substr(sp + 1, pos + rec_len - sp - 2);
+            if (rec.rfind("path=", 0) == 0) pending_name = rec.substr(5);
+            if (rec.rfind("size=", 0) == 0)
+              pending_size = strtoll(rec.c_str() + 5, nullptr, 10);
+            pos += (size_t)rec_len;
+          }
+        }
+        continue;
+      }
+      if (pending_size >= 0) {
+        size = pending_size;
+        pending_size = -1;
+      }
       int64_t padded = (size + 511) & ~511LL;
 
       bool regular = (type == '0' || type == 0);
       if (!regular || size < 0) {  // skip payload of non-regular members
-        for (int64_t left = padded; left > 0;) {
-          char skip[4096];
-          size_t n = in.read(skip, left > 4096 ? 4096 : (size_t)left);
-          if (n == 0) { aborted = true; break; }
-          left -= (int64_t)n;
-        }
+        pending_name.clear();  // overrides apply only to the NEXT member
+        if (!skip_bytes(in, padded)) break;
         continue;
+      }
+      if (!pending_name.empty()) {
+        name = pending_name;
+        pending_name.clear();
       }
 
       std::vector<uint8_t> payload((size_t)size);
-      size_t got = 0;
-      while (got < (size_t)size) {
-        size_t n = in.read(payload.data() + got, (size_t)size - got);
-        if (n == 0) { aborted = true; break; }
-        got += n;
-      }
-      if (aborted) break;
-      for (int64_t left = padded - size; left > 0;) {
-        char skip[512];
-        size_t n = in.read(skip, (size_t)left);
-        if (n == 0) { aborted = true; break; }
-        left -= (int64_t)n;
-      }
+      if (!read_fully(in, payload.data(), (size_t)size)) break;
+      if (!skip_bytes(in, padded - size)) break;
 
       std::string stem, ext;
       split_name(name, &stem, &ext);
