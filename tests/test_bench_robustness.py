@@ -111,3 +111,21 @@ def test_bench_success_path_on_cpu():
     assert line["metric"].startswith("mae_vit_t16")
     assert line["value"] and line["value"] > 0
     assert line["ms_step_bf16"] > 0
+
+
+def test_entry_guard_raises_instead_of_hanging():
+    """entry() reuses bench's hang-proof backend acquisition: on an
+    unusable backend it must raise a clear error (never block the driver's
+    compile check). The forced-failure hook covers both its branches."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_PROBE_FAIL"] = "permanent"
+    proc = subprocess.run(
+        [sys.executable, "-c", "import __graft_entry__ as g; g.entry()"],
+        env=env,
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "permanently unusable" in proc.stderr
