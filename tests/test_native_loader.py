@@ -307,3 +307,35 @@ def test_native_reader_lifecycle_stress(shards):
         for _ in range(i % 5):
             next(reader)
         reader.close()
+
+
+def test_native_multi_process_striping_disjoint_and_deterministic(shards):
+    """Two native-IO processes must stream disjoint shard stripes whose
+    union is the dataset, deterministically — same contract the python
+    path proves in test_data_pipeline."""
+    from jumbo_mae_tpu_tpu.data import DataConfig
+    from jumbo_mae_tpu_tpu.data.loader import native_train_stream
+
+    def one_epoch_labels(process_index):
+        cfg = DataConfig(
+            train_shards=list(shards),
+            image_size=16,
+            use_native=True,
+            native_io_threads=2,
+            decode_threads=1,
+            shuffle_buffer=2,
+            seed=9,
+        )
+        stream = native_train_stream(
+            cfg, process_index=process_index, process_count=2
+        )
+        # 3 shards split 2 ways -> stripes of 2 and 1 shards (10/5 samples)
+        n = 10 if process_index == 0 else 5
+        out = [label for _, label in (next(stream) for _ in range(n))]
+        stream.close()
+        return out
+
+    a0, a1 = one_epoch_labels(0), one_epoch_labels(1)
+    assert one_epoch_labels(0) == a0  # deterministic
+    assert set(a0).isdisjoint(a1)
+    assert sorted(a0 + a1) == list(range(15))
