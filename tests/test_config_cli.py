@@ -285,3 +285,38 @@ def test_gather_pick_cursor_preserves_native_marker(monkeypatch):
     picked = cli_train._pick_process_cursor(gathered)
     assert picked["native_threads"] == 2
     assert picked["workers"] == [[0, 12]]
+
+
+def test_sweep_ft_grid_matches_reference_loops():
+    """recipes/sweep_ft.py replaces the reference's loop_*.sh wd x lr grids:
+    the dry run must enumerate the full 4x2 grid and every override set
+    must load cleanly against the finetune recipe."""
+    import subprocess
+    import sys
+
+    repo = RECIPES.parent
+    proc = subprocess.run(
+        [sys.executable, str(RECIPES / "sweep_ft.py"), "--dry-run"],
+        cwd=str(repo),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("sweep:")]
+    assert len(lines) == 8  # 4 weight decays x 2 learning rates
+    import ast
+
+    grid = set()
+    for ln in lines:
+        overrides = ast.literal_eval(ln.split("sweep:", 1)[1].strip())
+        cfg = load_config(RECIPES / "finetune_vit_b16.yaml", overrides)
+        assert cfg.optim.layer_decay == 0.65
+        assert cfg.run.name.startswith("ft_sweep_wd")
+        grid.add((cfg.optim.weight_decay, cfg.optim.learning_rate))
+    # the reference's loop_1.sh/loop_2.sh grid, exactly
+    assert grid == {
+        (wd, lr)
+        for wd in (0.06, 0.07, 0.08, 0.09)
+        for lr in (1e-3, 3e-3)
+    }
