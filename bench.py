@@ -175,8 +175,14 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
         dtype=dtype,
         # an explicit BENCH_REMAT_POLICY also turns remat ON for models that
         # default to remat=False — otherwise the override would silently
-        # no-op (maybe_remat ignores the policy when grad_ckpt is false)
-        grad_ckpt=spec["remat"] or bool(os.environ.get("BENCH_REMAT_POLICY")),
+        # no-op (maybe_remat ignores the policy when grad_ckpt is false);
+        # BENCH_REMAT=0/1 force-overrides both (bf16 moments freed enough
+        # HBM that no-remat ViT-H/14 fits at the bench batch)
+        grad_ckpt=(
+            bool(int(os.environ["BENCH_REMAT"]))
+            if os.environ.get("BENCH_REMAT")
+            else spec["remat"] or bool(os.environ.get("BENCH_REMAT_POLICY"))
+        ),
         remat_policy=os.environ.get(
             "BENCH_REMAT_POLICY", spec.get("remat_policy", "none")
         ),
@@ -209,6 +215,7 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
             warmup_steps=100,
             training_steps=10_000,
             mu_dtype=os.environ.get("BENCH_MU_DTYPE") or None,
+            nu_dtype=os.environ.get("BENCH_NU_DTYPE") or None,
         ),
         global_batch_size=batch_size,
     )

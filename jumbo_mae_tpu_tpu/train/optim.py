@@ -22,6 +22,8 @@ from dataclasses import dataclass
 from functools import partial
 from typing import Literal
 
+import jax
+import jax.numpy as jnp
 import optax
 from jax.tree_util import tree_map_with_path
 
@@ -49,6 +51,12 @@ class OptimConfig:
     # first-moment HBM traffic in the (bandwidth-bound) optimizer update; the
     # second moment and params stay float32.
     mu_dtype: str | None = None
+    # dtype for the Adam second moment. The EMA itself always computes in
+    # float32 (only the *stored* moment is cast), but bf16's 8-bit mantissa
+    # quantizes the stored EMA between steps — an explicit opt-in perf knob
+    # for bandwidth-bound large models (PERF.md §ViT-H/14), never a silent
+    # default.
+    nu_dtype: str | None = None
 
     def peak_lr(self, global_batch_size: int) -> float:
         if self.lr_scaling == "batch":
@@ -88,12 +96,81 @@ def make_schedule(cfg: OptimConfig, global_batch_size: int) -> optax.Schedule:
     )
 
 
+def scale_by_adam_dtyped(
+    b1, b2, eps, mu_dtype=None, nu_dtype=None
+) -> optax.GradientTransformation:
+    """``optax.scale_by_adam`` with independently castable stored moments.
+
+    optax only exposes ``mu_dtype``; this adds ``nu_dtype`` with the same
+    contract: the EMAs and the update are computed in float32 (cast up from
+    whatever is stored), and only the moment written back to the optimizer
+    state is cast down. With both dtypes ``None`` the math is identical to
+    ``optax.scale_by_adam`` (covered by a bit-parity test)."""
+    mu_dtype = jnp.dtype(mu_dtype) if mu_dtype else None
+    nu_dtype = jnp.dtype(nu_dtype) if nu_dtype else None
+
+    def init_fn(params):
+        mu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params
+        )
+        nu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=nu_dtype or p.dtype), params
+        )
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32), mu=mu, nu=nu
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = optax.safe_increment(state.count)
+        f32 = jnp.float32
+        mu_f = jax.tree.map(
+            lambda g, m: b1 * m.astype(f32) + (1 - b1) * g.astype(f32),
+            updates,
+            state.mu,
+        )
+        nu_f = jax.tree.map(
+            lambda g, n: b2 * n.astype(f32)
+            + (1 - b2) * jnp.square(g.astype(f32)),
+            updates,
+            state.nu,
+        )
+        c1 = 1 - jnp.asarray(b1, f32) ** count.astype(f32)
+        c2 = 1 - jnp.asarray(b2, f32) ** count.astype(f32)
+        out = jax.tree.map(
+            lambda g, m, n: ((m / c1) / (jnp.sqrt(n / c2) + eps)).astype(
+                g.dtype
+            ),
+            updates,
+            mu_f,
+            nu_f,
+        )
+        mu_s = jax.tree.map(
+            lambda m: m.astype(mu_dtype) if mu_dtype else m, mu_f
+        )
+        nu_s = jax.tree.map(
+            lambda n: n.astype(nu_dtype) if nu_dtype else n, nu_f
+        )
+        return out, optax.ScaleByAdamState(count=count, mu=mu_s, nu=nu_s)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _scale_by_adam(b1, b2, eps, mu_dtype=None, nu_dtype=None):
+    """Stock optax unless ``nu_dtype`` forces the dtyped variant."""
+    if nu_dtype:
+        return scale_by_adam_dtyped(
+            b1, b2, eps, mu_dtype=mu_dtype, nu_dtype=nu_dtype
+        )
+    return optax.scale_by_adam(b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype)
+
+
 def modified_lamb(
-    learning_rate, b1, b2, eps, weight_decay, mask, mu_dtype=None
+    learning_rate, b1, b2, eps, weight_decay, mask, mu_dtype=None, nu_dtype=None
 ) -> optax.GradientTransformation:
     """LAMB with the trust ratio restricted to weight-decayed params."""
     return optax.chain(
-        optax.scale_by_adam(b1=b1, b2=b2, eps=eps, mu_dtype=mu_dtype),
+        _scale_by_adam(b1, b2, eps, mu_dtype=mu_dtype, nu_dtype=nu_dtype),
         optax.add_decayed_weights(weight_decay=weight_decay, mask=mask),
         optax.masked(optax.scale_by_trust_ratio(), mask=mask),
         optax.scale_by_learning_rate(learning_rate),
@@ -113,14 +190,20 @@ def make_optimizer(
     def build(learning_rate):
         wd_mask = kernel_mask
         if cfg.name == "adamw":
-            tx = optax.adamw(
-                learning_rate,
-                b1=cfg.b1,
-                b2=cfg.b2,
-                eps=cfg.eps,
-                weight_decay=cfg.weight_decay,
-                mask=wd_mask,
-                mu_dtype=cfg.mu_dtype,
+            # optax.adamw's own chain, with the dtyped core swapped in when
+            # nu_dtype asks for it (optax exposes no nu_dtype).
+            tx = optax.chain(
+                _scale_by_adam(
+                    cfg.b1,
+                    cfg.b2,
+                    cfg.eps,
+                    mu_dtype=cfg.mu_dtype,
+                    nu_dtype=cfg.nu_dtype,
+                ),
+                optax.add_decayed_weights(
+                    weight_decay=cfg.weight_decay, mask=wd_mask
+                ),
+                optax.scale_by_learning_rate(learning_rate),
             )
         elif cfg.name == "lamb":
             tx = modified_lamb(
@@ -131,6 +214,7 @@ def make_optimizer(
                 cfg.weight_decay,
                 wd_mask,
                 mu_dtype=cfg.mu_dtype,
+                nu_dtype=cfg.nu_dtype,
             )
         elif cfg.name == "lars":
             tx = optax.lars(learning_rate, momentum=cfg.momentum)

@@ -441,6 +441,86 @@ class TestOptim:
         assert layer_index(path_of("model", "head", "fc"), num_layers=12) == 12
         assert layer_index(path_of("model", "cls_tokens"), num_layers=12) == 12
 
+    def test_scale_by_adam_dtyped_matches_optax_in_f32(self):
+        """With no dtype casts the custom core is bit-identical to optax."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from jumbo_mae_tpu_tpu.train.optim import scale_by_adam_dtyped
+
+        params = {
+            "kernel": jnp.linspace(-1.0, 1.0, 12).reshape(3, 4),
+            "bias": jnp.arange(4, dtype=jnp.float32),
+        }
+        ref = optax.scale_by_adam(b1=0.9, b2=0.95, eps=1e-8)
+        got = scale_by_adam_dtyped(0.9, 0.95, 1e-8)
+        s_ref, s_got = ref.init(params), got.init(params)
+        g = jax.tree.map(lambda p: 0.01 * (p + 1.0), params)
+        for _ in range(3):
+            u_ref, s_ref = ref.update(g, s_ref)
+            u_got, s_got = got.update(g, s_got)
+        for a, b in zip(jax.tree.leaves(u_ref), jax.tree.leaves(u_got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree.leaves(s_ref.nu), jax.tree.leaves(s_got.nu)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_nu_dtype_casts_state_and_tracks_f32(self):
+        """nu_dtype=bfloat16 stores bf16 moments; updates stay close to the
+        f32 chain (the EMA is computed in f32, only storage is cast)."""
+        import jax
+        import jax.numpy as jnp
+
+        from jumbo_mae_tpu_tpu.train.optim import scale_by_adam_dtyped
+
+        params = {"kernel": jnp.linspace(-0.5, 0.5, 64).reshape(8, 8)}
+        f32 = scale_by_adam_dtyped(0.9, 0.95, 1e-8)
+        cast = scale_by_adam_dtyped(
+            0.9, 0.95, 1e-8, mu_dtype="bfloat16", nu_dtype="bfloat16"
+        )
+        s32, sc = f32.init(params), cast.init(params)
+        assert sc.mu["kernel"].dtype == jnp.bfloat16
+        assert sc.nu["kernel"].dtype == jnp.bfloat16
+        g = jax.tree.map(lambda p: 0.02 * jnp.cos(7.0 * p), params)
+        for _ in range(5):
+            u32, s32 = f32.update(g, s32)
+            uc, sc = cast.update(g, sc)
+        assert sc.nu["kernel"].dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(uc["kernel"], np.float32),
+            np.asarray(u32["kernel"], np.float32),
+            rtol=2e-2,
+            atol=2e-2,
+        )
+
+    def test_make_optimizer_nu_dtype_wires_through(self):
+        import jax
+        import jax.numpy as jnp
+
+        opt = OptimConfig(
+            name="adamw",
+            learning_rate=1e-3,
+            lr_scaling="none",
+            warmup_steps=0,
+            training_steps=10,
+            mu_dtype="bfloat16",
+            nu_dtype="bfloat16",
+        )
+        tx = make_optimizer(opt, 256)
+        params = {"kernel": jnp.ones((4, 4))}
+        state = tx.init(params)
+        dtypes = {
+            str(leaf.dtype)
+            for leaf in jax.tree.leaves(state)
+            if hasattr(leaf, "dtype") and leaf.ndim == 2
+        }
+        assert "bfloat16" in dtypes
+        g = {"kernel": jnp.full((4, 4), 0.01)}
+        updates, state = tx.update(g, state, params)
+        assert np.all(np.isfinite(np.asarray(updates["kernel"], np.float32)))
+
     @pytest.mark.parametrize("name", ["adamw", "lamb", "lars", "sgd"])
     def test_all_optimizers_step(self, name):
         batch = batch_of(8, labels=np.arange(8) % 10)
