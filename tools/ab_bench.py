@@ -12,9 +12,14 @@ how PERF.md A/B tables are produced without babysitting:
         --sweep BENCH_MU_DTYPE=,bfloat16 \
         --skip-baseline --out /tmp/h14_ab.jsonl
 
-Each --sweep is KNOB=v1,v2,... (empty string = unset). Failed runs are
-recorded with their error line (bench.py emits machine-readable JSON even
-on failure) and the sweep continues.
+Each --sweep is KNOB=v1,v2,... (empty string = unset → the MODEL'S
+defaults, which for vit_h14's bf16 leg are the baked-in winners:
+remat off, bf16 moments, onehot gather — bench.py MODELS). To put a
+default-ON knob in its off state, sweep its explicit off spelling
+instead of the empty string: BENCH_MU_DTYPE=float32,
+BENCH_NU_DTYPE=float32, BENCH_GATHER_IMPL=take, BENCH_REMAT=1.
+Failed runs are recorded with their error line (bench.py emits
+machine-readable JSON even on failure) and the sweep continues.
 """
 
 from __future__ import annotations
