@@ -131,7 +131,16 @@ def acquire_backend(
 MODELS = {
     # test-sized smoke config: fast bench/profile sanity on any backend
     "vit_t16": dict(dec=dict(layers=2, dim=64, heads=4), batch=8, remat=False),
-    "vit_l16": dict(dec=dict(layers=8, dim=512, heads=16), batch=128, remat=False),
+    "vit_l16": dict(
+        dec=dict(layers=8, dim=512, heads=16),
+        batch=128,
+        remat=False,
+        # bf16-leg defaults (PERF.md §Round 3 on-chip, vit_l16 sweep):
+        # bf16 moments +1.3%; onehot gather is a clear LOSS here (−8%,
+        # the opposite of vit_h14 — the 0/1 matmuls outgrow the gather
+        # saving at batch 128 / decoder dim 512), so take stays.
+        bf16=dict(mu_dtype="bfloat16", nu_dtype="bfloat16"),
+    ),
     # batch 64 + dots-saveable remat measured fastest on 16 GB v5e (PERF.md:
     # 244 img/s vs 166 at the round-1 batch-32 full-remat config; 96 OOMs).
     # The reference-style f32 leg doubles every activation, so it gets its
