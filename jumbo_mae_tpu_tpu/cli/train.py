@@ -542,22 +542,30 @@ def train(cfg: TrainConfig) -> dict:
     return last_metrics
 
 
-def main(argv: list[str] | None = None):
+def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--config", type=str, default=None, help="YAML recipe path")
     parser.add_argument(
         "--set",
         dest="overrides",
         nargs="*",
+        action="extend",
         default=[],
-        help="dotted config overrides: optim.learning_rate=1e-3",
+        help="dotted config overrides: optim.learning_rate=1e-3 "
+        "(repeatable — `--set a=1 --set b=2` and `--set a=1 b=2` are "
+        "equivalent; without extend, a repeated flag would silently "
+        "drop the earlier overrides)",
     )
     parser.add_argument(
         "--distributed",
         action="store_true",
         help="call jax.distributed.initialize() (multi-host pods)",
     )
-    args = parser.parse_args(argv)
+    return parser
+
+
+def main(argv: list[str] | None = None):
+    args = build_parser().parse_args(argv)
     if args.distributed:
         jax.distributed.initialize()
     cfg = load_config(args.config, args.overrides)

@@ -67,6 +67,22 @@ def test_overrides_dotted_paths():
     assert cfg.run.mode == "finetune"
 
 
+def test_repeated_set_flags_accumulate():
+    """`--set a=1 --set b=2` must apply BOTH (argparse nargs='*' without
+    action='extend' silently drops all but the last --set group)."""
+    from jumbo_mae_tpu_tpu.cli.train import build_parser
+
+    ns = build_parser().parse_args(
+        ["--set", "run.training_steps=30", "--set", "run.name=x", "b=2"]
+    )
+    assert ns.overrides == ["run.training_steps=30", "run.name=x", "b=2"]
+
+    doc = apply_overrides({}, ["run.training_steps=30", "run.name=xyz"])
+    cfg = config_from_dict(doc)
+    assert cfg.run.training_steps == 30
+    assert cfg.run.name == "xyz"
+
+
 def test_unknown_key_rejected():
     with pytest.raises(ValueError, match="unknown"):
         config_from_dict({"run": {"bogus_key": 1}})
