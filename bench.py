@@ -141,14 +141,17 @@ MODELS = {
         # saving at batch 128 / decoder dim 512), so take stays.
         bf16=dict(mu_dtype="bfloat16", nu_dtype="bfloat16"),
     ),
-    # batch 64 + dots-saveable remat measured fastest on 16 GB v5e (PERF.md:
-    # 244 img/s vs 166 at the round-1 batch-32 full-remat config; 96 OOMs).
     # The reference-style f32 leg doubles every activation, so it gets its
-    # own largest-fitting batch (64 f32 needs ~20 GB); the ratio compares
-    # per-image throughput, each leg at its feasible batch.
+    # own largest-fitting batch (f32 at the bf16 leg's batch needs ~20 GB);
+    # the ratio compares per-image throughput, each leg at its feasible
+    # batch, plus an equal-batch ratio in the JSON. The f32 leg keeps the
+    # dots remat that batch 32 f32 needs to fit on 16 GB.
     "vit_h14": dict(
         dec=dict(layers=8, dim=512, heads=16),
-        batch=64,
+        # batch 72 re-swept fastest once the bf16-moment/no-remat stack
+        # landed (294 vs 288@64 / 292@80 img/s) — the shared jumbo-MLP
+        # weight traffic amortizes over more rows (PERF.md §Round 3)
+        batch=72,
         f32_batch=32,
         remat=True,
         remat_policy="dots",

@@ -17,8 +17,14 @@ PEAK_TFLOPS = {
     "v3": 123.0,
     "v4": 275.0,
     "v5e": 197.0,
+    # PJRT device_kind spells the e-variants "lite": 'TPU v5 lite',
+    # 'TPU v6 lite' (observed live; the v5e key alone never matched, which
+    # silently disabled bench.py's timing-plausibility guard on real v5e)
+    "v5 lite": 197.0,
+    "v5litepod": 197.0,
     "v5p": 459.0,
     "v6e": 918.0,
+    "v6 lite": 918.0,
 }
 
 

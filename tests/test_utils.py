@@ -172,3 +172,28 @@ def test_param_summary():
     out = param_summary(params)
     assert "encoder/block_0" in out and "head" in out
     assert "total" in out and "154" in out  # 32 + 32 + 80 + 10
+
+
+def test_detect_peak_tflops_device_kind_spellings(monkeypatch):
+    """PJRT spells the e-variants 'lite' ('TPU v5 lite'); an unmatched kind
+    must fall back to the caller's default (bench.py passes 0.0 to disable
+    its plausibility guard rather than guess)."""
+    import jax
+
+    from jumbo_mae_tpu_tpu.utils.mfu import detect_peak_tflops
+
+    class _Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    cases = {
+        "TPU v5 lite": 197.0,
+        "TPU v5e": 197.0,
+        "TPU v5p": 459.0,
+        "TPU v6 lite": 918.0,
+        "TPU v4": 275.0,
+        "weird accelerator": 0.0,  # falls back to the default
+    }
+    for kind, want in cases.items():
+        monkeypatch.setattr(jax, "devices", lambda k=kind: [_Dev(k)])
+        assert detect_peak_tflops(default=0.0) == want, kind
