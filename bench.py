@@ -133,7 +133,11 @@ MODELS = {
     "vit_t16": dict(dec=dict(layers=2, dim=64, heads=4), batch=8, remat=False),
     "vit_l16": dict(
         dec=dict(layers=8, dim=512, heads=16),
-        batch=128,
+        # 192 re-swept fastest once bf16 moments landed (669.6 vs 654.0@128,
+        # 653.7@160, 617.3@224 — the pre-bf16 sweeps had 128 winning); the
+        # f32 reference leg stays at its established 128.
+        batch=192,
+        f32_batch=128,
         remat=False,
         # bf16-leg defaults (PERF.md §Round 3 on-chip, vit_l16 sweep):
         # bf16 moments +1.3%; onehot gather is a clear LOSS here (−8%,

@@ -54,8 +54,10 @@ def capture(model: str, steps: int, out_dir: str, batch: int | None) -> str:
     return max(traces, key=os.path.getmtime)
 
 
-def aggregate(trace_path: str, steps: int) -> tuple[dict, list]:
-    """Sum device-track event durations by hlo_category and by op name.
+def aggregate(trace_path: str, steps: int) -> tuple[dict, list, list, list]:
+    """Sum device-track event durations by hlo_category and by op name,
+    plus per-source-line totals and per-tf_op (time, flops, bytes) rows
+    for achieved-TF/s / GB/s attribution.
 
     Device tracks are the pids whose process names mention the accelerator
     (\"/device:TPU\" etc.); host/python tracks are excluded so the table is
@@ -95,15 +97,35 @@ def aggregate(trace_path: str, steps: int) -> tuple[dict, list]:
 
     by_cat: dict[str, float] = collections.defaultdict(float)
     by_op: dict[str, float] = collections.defaultdict(float)
+    by_src: dict[str, float] = collections.defaultdict(float)
+    # tf_op → [device_us, model_flops, raw_bytes]: per-op achieved TF/s and
+    # GB/s — tells FLOP-bound from HBM-bound apart op by op, which is what
+    # actually picks the next optimization (PERF.md §Round 3 workflow)
+    by_tf: dict[str, list] = collections.defaultdict(lambda: [0.0, 0.0, 0.0])
     for e in events:
         if e.get("ph") != "X" or e.get("pid") not in device_pids:
             continue
+        a = e.get("args", {})
         dur_ms = e.get("dur", 0) / 1e3 / steps
-        cat = e.get("args", {}).get("hlo_category") or "(uncategorized)"
+        cat = a.get("hlo_category") or "(uncategorized)"
         by_cat[cat] += dur_ms
         by_op[e.get("name", "?")] += dur_ms
+        if a.get("hlo_category"):  # real op events only — module spans
+            # carry no category and would double-count their children
+            by_src[a.get("source") or "(no source)"] += dur_ms
+            r = by_tf[a.get("tf_op") or "(no tf_op)"]
+            r[0] += e.get("dur", 0)
+            # some trace exporters emit formatted/empty strings here —
+            # skip the stat rather than abort the whole aggregation
+            for i, key in ((1, "model_flops"), (2, "raw_bytes_accessed")):
+                try:
+                    r[i] += float(a.get(key) or 0)
+                except (TypeError, ValueError):
+                    pass
     top_ops = sorted(by_op.items(), key=lambda kv: -kv[1])[:20]
-    return dict(by_cat), top_ops
+    top_src = sorted(by_src.items(), key=lambda kv: -kv[1])[:15]
+    top_tf = sorted(by_tf.items(), key=lambda kv: -kv[1][0])[:15]
+    return dict(by_cat), top_ops, top_src, top_tf
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -120,7 +142,7 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     path = args.trace or capture(args.model, args.steps, args.out, args.batch)
-    by_cat, top_ops = aggregate(path, args.steps)
+    by_cat, top_ops, top_src, top_tf = aggregate(path, args.steps)
     total = sum(by_cat.values())
     print(f"\ndevice time by hlo_category (ms/step, {args.steps} steps):")
     for cat, ms in sorted(by_cat.items(), key=lambda kv: -kv[1]):
@@ -129,6 +151,15 @@ def main(argv: list[str] | None = None) -> int:
     print("\ntop ops by self time (ms/step):")
     for name, ms in top_ops:
         print(f"  {ms:8.3f}  {name[:100]}")
+    print("\ndevice time by source line (ms/step):")
+    for src, ms in top_src:
+        print(f"  {ms:8.2f}  {src}")
+    print("\ntop tf_ops: ms/step, achieved TF/s, GB/s (FLOP- vs HBM-bound):")
+    for op, (us, flops, nbytes) in top_tf:
+        secs = us / 1e6
+        tf = flops / secs / 1e12 if secs else 0.0
+        gb = nbytes / secs / 1e9 if secs else 0.0
+        print(f"  {us / 1e3 / args.steps:8.2f} ms {tf:7.1f} TF/s {gb:7.0f} GB/s  {op[:85]}")
     print(f"\ntrace: {path}")
     return 0
 
