@@ -179,6 +179,52 @@ MODELS = {
 }
 
 
+def leg_config(model: str, dtype: str, env=None) -> dict:
+    """Resolve the per-leg bench knobs — pure and unit-testable.
+
+    The bf16 leg is the framework at its measured-best TPU config (spec
+    "bf16" defaults + BENCH_* env overrides); the f32 leg is the FIXED
+    reference-style baseline — env knobs and bf16 defaults never touch it,
+    so the two legs stay comparable across sweeps.
+
+    Remat subtlety: an explicit BENCH_REMAT_POLICY also turns remat ON for
+    models that default to remat=False — otherwise the override would
+    silently no-op (maybe_remat ignores the policy when grad_ckpt is
+    false); BENCH_REMAT=0/1 force-overrides both (bf16 moments freed
+    enough HBM that no-remat ViT-H/14 fits at the bench batch)."""
+    env = os.environ if env is None else env
+    spec = MODELS[model]
+    framework_leg = dtype == "bfloat16"
+    leg = spec.get("bf16", {}) if framework_leg else {}
+
+    def knob(env_name: str, default):
+        if framework_leg and env.get(env_name):
+            return env[env_name]
+        return default
+
+    remat_env = env.get("BENCH_REMAT") if framework_leg else None
+    grad_ckpt = (
+        bool(int(remat_env))
+        if remat_env
+        else leg.get("remat", spec["remat"])
+        or bool(knob("BENCH_REMAT_POLICY", ""))
+    )
+    return dict(
+        grad_ckpt=grad_ckpt,
+        remat_policy=knob(
+            "BENCH_REMAT_POLICY", spec.get("remat_policy", "none")
+        ),
+        # masking gather lowering: "take" (XLA gather) vs "onehot" (MXU
+        # matmul, concat-free unshuffle) — bit-identical, A/B by profile
+        gather_impl=knob("BENCH_GATHER_IMPL", leg.get("gather", "take")),
+        # decoder-side remat is its own experiment axis (the decoder runs
+        # at head_dim 32 and is un-rematerialized by default)
+        dec_remat=env.get("BENCH_DEC_REMAT_POLICY") if framework_leg else None,
+        mu_dtype=knob("BENCH_MU_DTYPE", leg.get("mu_dtype")) or None,
+        nu_dtype=knob("BENCH_NU_DTYPE", leg.get("nu_dtype")) or None,
+    )
+
+
 def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
     import jax
 
@@ -196,32 +242,10 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
     )
 
     spec = MODELS[model]
-    # The bf16 leg is the framework at its measured-best TPU config (spec
-    # "bf16" defaults + BENCH_* env overrides); the f32 leg is the FIXED
-    # reference-style baseline — env knobs and bf16 defaults never touch it,
-    # so the two legs stay comparable across sweeps.
-    framework_leg = dtype == "bfloat16"
-    leg = spec.get("bf16", {}) if framework_leg else {}
-
-    def knob(env_name: str, default):
-        if framework_leg and os.environ.get(env_name):
-            return os.environ[env_name]
-        return default
+    knobs = leg_config(model, dtype)
 
     mesh = create_mesh(
         MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1]
-    )
-    # an explicit BENCH_REMAT_POLICY also turns remat ON for models that
-    # default to remat=False — otherwise the override would silently
-    # no-op (maybe_remat ignores the policy when grad_ckpt is false);
-    # BENCH_REMAT=0/1 force-overrides both (bf16 moments freed enough
-    # HBM that no-remat ViT-H/14 fits at the bench batch)
-    remat_env = os.environ.get("BENCH_REMAT") if framework_leg else None
-    grad_ckpt = (
-        bool(int(remat_env))
-        if remat_env
-        else leg.get("remat", spec["remat"])
-        or bool(knob("BENCH_REMAT_POLICY", ""))
     )
     enc = preset(
         model,
@@ -229,19 +253,11 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
         labels=None,
         posemb="sincos2d",
         dtype=dtype,
-        grad_ckpt=grad_ckpt,
-        remat_policy=knob(
-            "BENCH_REMAT_POLICY", spec.get("remat_policy", "none")
-        ),
-        # masking gather lowering: "take" (XLA gather) vs "onehot" (MXU
-        # matmul, concat-free unshuffle) — bit-identical, A/B by profile
-        gather_impl=knob("BENCH_GATHER_IMPL", leg.get("gather", "take")),
+        grad_ckpt=knobs["grad_ckpt"],
+        remat_policy=knobs["remat_policy"],
+        gather_impl=knobs["gather_impl"],
     )
-    # decoder-side remat is its own experiment axis (the decoder runs seq
-    # 199 at head_dim 32 and is un-rematerialized by default)
-    dec_remat = (
-        os.environ.get("BENCH_DEC_REMAT_POLICY") if framework_leg else None
-    )
+    dec_remat = knobs["dec_remat"]
     dec = DecoderConfig(
         **spec["dec"],
         dtype=dtype,
@@ -263,8 +279,8 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
             weight_decay=0.05,
             warmup_steps=100,
             training_steps=10_000,
-            mu_dtype=knob("BENCH_MU_DTYPE", leg.get("mu_dtype")) or None,
-            nu_dtype=knob("BENCH_NU_DTYPE", leg.get("nu_dtype")) or None,
+            mu_dtype=knobs["mu_dtype"],
+            nu_dtype=knobs["nu_dtype"],
         ),
         global_batch_size=batch_size,
     )
