@@ -131,6 +131,18 @@ def acquire_backend(
 MODELS = {
     # test-sized smoke config: fast bench/profile sanity on any backend
     "vit_t16": dict(dec=dict(layers=2, dim=64, heads=4), batch=8, remat=False),
+    # the reference's OTHER headline pretrain workload (B/16 1600ep,
+    # /root/reference/config/pretrain/pretrain-vit-b16-224-in1k-1600ep.sh);
+    # same 8x512x16h decoder as L
+    "vit_b16": dict(
+        dec=dict(layers=8, dim=512, heads=16),
+        # swept on-chip: 192 peaks (1285 vs 1210@128, 1236@256, 1184@384,
+        # 1115@512); onehot gather loses ~3% at every batch (like L)
+        batch=192,
+        f32_batch=128,
+        remat=False,
+        bf16=dict(mu_dtype="bfloat16", nu_dtype="bfloat16"),
+    ),
     "vit_l16": dict(
         dec=dict(layers=8, dim=512, heads=16),
         # 192 re-swept fastest once bf16 moments landed (669.6 vs 654.0@128,
