@@ -221,7 +221,7 @@ def leg_config(model: str, dtype: str, env=None) -> dict:
         else leg.get("remat", spec["remat"])
         or bool(knob("BENCH_REMAT_POLICY", ""))
     )
-    return dict(
+    out = dict(
         grad_ckpt=grad_ckpt,
         remat_policy=knob(
             "BENCH_REMAT_POLICY", spec.get("remat_policy", "none")
@@ -234,7 +234,27 @@ def leg_config(model: str, dtype: str, env=None) -> dict:
         dec_remat=env.get("BENCH_DEC_REMAT_POLICY") if framework_leg else None,
         mu_dtype=knob("BENCH_MU_DTYPE", leg.get("mu_dtype")) or None,
         nu_dtype=knob("BENCH_NU_DTYPE", leg.get("nu_dtype")) or None,
+        # attention lowering (einsum/flash/ring/auto): at long context the
+        # flash kernel avoids materializing the O(S^2) score tensor, which
+        # is what OOMs the einsum path first (PERF.md long-context rows)
+        attn_impl=knob("BENCH_ATTN_IMPL", "auto"),
     )
+    if out["attn_impl"] not in ("einsum", "flash", "ring", "auto"):
+        # the model's dispatch would silently fall back to einsum and the
+        # bench would attribute an einsum measurement to the wrong kernel
+        raise SystemExit(
+            f"unknown BENCH_ATTN_IMPL {out['attn_impl']!r}; "
+            "choose einsum/flash/ring/auto"
+        )
+    return out
+
+
+def bench_image_size() -> int:
+    """Long-context benching is one knob away: BENCH_IMAGE_SIZE=448 (etc.)
+    scales the patch grid. Single parse point — the metric name and the
+    workload must agree (the name carries the size so records never mix
+    resolutions)."""
+    return int(os.environ.get("BENCH_IMAGE_SIZE", "224"))
 
 
 def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
@@ -259,20 +279,24 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
     mesh = create_mesh(
         MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1]
     )
+    image_size = bench_image_size()
     enc = preset(
         model,
         mask_ratio=0.75,
         labels=None,
         posemb="sincos2d",
         dtype=dtype,
+        image_size=image_size,
         grad_ckpt=knobs["grad_ckpt"],
         remat_policy=knobs["remat_policy"],
         gather_impl=knobs["gather_impl"],
+        attn_impl=knobs["attn_impl"],
     )
     dec_remat = knobs["dec_remat"]
     dec = DecoderConfig(
         **spec["dec"],
         dtype=dtype,
+        attn_impl=knobs["attn_impl"],
         grad_ckpt=bool(dec_remat),
         remat_policy=dec_remat or "none",
     )
@@ -280,7 +304,7 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
 
     batch = {
         "images": np.random.RandomState(0).randint(
-            0, 256, (batch_size, 224, 224, 3), dtype=np.uint8
+            0, 256, (batch_size, image_size, image_size, 3), dtype=np.uint8
         )
     }
     tx = make_optimizer(
@@ -379,7 +403,8 @@ def _run_bench() -> dict:
         )
     batch_size = int(os.environ.get("BENCH_BATCH", str(MODELS[model]["batch"])))
     iters = int(os.environ.get("BENCH_ITERS", "10"))
-    _partial["metric"] = f"mae_{model}_224_pretrain_imgs_per_sec_per_chip"
+    size = bench_image_size()
+    _partial["metric"] = f"mae_{model}_{size}_pretrain_imgs_per_sec_per_chip"
 
     step, state, batch, floor_ms = build_step("bfloat16", batch_size, model)
     dt = time_steps(

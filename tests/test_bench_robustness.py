@@ -145,6 +145,7 @@ def test_leg_config_f32_leg_is_env_proof():
         "BENCH_NU_DTYPE": "bfloat16",
         "BENCH_DEC_REMAT_POLICY": "dots",
     }
+    hostile_env["BENCH_ATTN_IMPL"] = "flash"
     got = bench.leg_config("vit_h14", "float32", env=hostile_env)
     assert got == dict(
         grad_ckpt=True,  # spec remat (f32@32 needs dots to fit 16 GB)
@@ -153,6 +154,7 @@ def test_leg_config_f32_leg_is_env_proof():
         dec_remat=None,
         mu_dtype=None,
         nu_dtype=None,
+        attn_impl="auto",
     )
 
 
@@ -168,6 +170,7 @@ def test_leg_config_bf16_defaults_and_overrides():
         dec_remat=None,
         mu_dtype="bfloat16",
         nu_dtype="bfloat16",
+        attn_impl="auto",
     )
     # explicit off-spellings flip every default-on knob back off
     off = {
