@@ -192,3 +192,40 @@ def test_leg_config_bf16_defaults_and_overrides():
     # BENCH_REMAT_POLICY alone must turn remat ON for a remat=False model
     got = bench.leg_config("vit_l16", "bfloat16", env={"BENCH_REMAT_POLICY": "dots"})
     assert got["grad_ckpt"] is True and got["remat_policy"] == "dots"
+
+
+def test_measure_leg_retries_transient_tunnel_faults(monkeypatch):
+    """A remote compile served over the tunnel can drop mid-body (seen
+    live: 'remote_compile: read body: ...'); the leg must retry on a fresh
+    build instead of turning the round artifact into an error line. OOMs
+    (RESOURCE_EXHAUSTED) must NOT retry."""
+    import bench
+
+    calls = {"n": 0}
+
+    def flaky_build(dtype, batch_size, model):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError(
+                "INTERNAL: http://127.0.0.1:8103/remote_compile: read body:"
+                " response body closed before all bytes were read"
+            )
+        return "step", "state", "batch", 0.0
+
+    monkeypatch.setattr(bench, "build_step", flaky_build)
+    monkeypatch.setattr(
+        bench, "time_steps", lambda *a, **k: 0.123
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._measure_leg("float32", 8, "vit_t16", 2) == 0.123
+    assert calls["n"] == 2
+
+    def oom_build(dtype, batch_size, model):
+        calls["n"] += 1
+        raise RuntimeError("RESOURCE_EXHAUSTED: Allocation type: HLO temp")
+
+    calls["n"] = 0
+    monkeypatch.setattr(bench, "build_step", oom_build)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        bench._measure_leg("bfloat16", 8, "vit_t16", 2)
+    assert calls["n"] == 1  # no retry on a permanent failure
