@@ -191,6 +191,12 @@ MODELS = {
 }
 
 
+def _norm_f32(value):
+    """Map the explicit "float32" off-spelling (and unset) to None so the
+    master-weights wrapper only engages for real low-precision storage."""
+    return None if value in (None, "", "float32") else value
+
+
 def leg_config(model: str, dtype: str, env=None) -> dict:
     """Resolve the per-leg bench knobs — pure and unit-testable.
 
@@ -215,12 +221,16 @@ def leg_config(model: str, dtype: str, env=None) -> dict:
         return default
 
     remat_env = env.get("BENCH_REMAT") if framework_leg else None
-    grad_ckpt = (
-        bool(int(remat_env))
-        if remat_env
-        else leg.get("remat", spec["remat"])
-        or bool(knob("BENCH_REMAT_POLICY", ""))
-    )
+    if remat_env:
+        if remat_env not in ("0", "1"):
+            raise SystemExit(
+                f"BENCH_REMAT={remat_env!r} not understood; use 0 or 1"
+            )
+        grad_ckpt = remat_env == "1"
+    else:
+        grad_ckpt = leg.get("remat", spec["remat"]) or bool(
+            knob("BENCH_REMAT_POLICY", "")
+        )
     out = dict(
         grad_ckpt=grad_ckpt,
         remat_policy=knob(
@@ -234,6 +244,11 @@ def leg_config(model: str, dtype: str, env=None) -> dict:
         dec_remat=env.get("BENCH_DEC_REMAT_POLICY") if framework_leg else None,
         mu_dtype=knob("BENCH_MU_DTYPE", leg.get("mu_dtype")) or None,
         nu_dtype=knob("BENCH_NU_DTYPE", leg.get("nu_dtype")) or None,
+        # parameter STORAGE dtype: "bfloat16" stores params bf16 with an f32
+        # master copy in the optimizer (train/optim.py with_master_weights) —
+        # halves weight-read HBM traffic. "float32" is the explicit off
+        # spelling for sweeping a default-on model.
+        param_dtype=_norm_f32(knob("BENCH_PARAM_DTYPE", leg.get("param_dtype"))),
         # attention lowering (einsum/flash/ring/auto): at long context the
         # flash kernel avoids materializing the O(S^2) score tensor, which
         # is what OOMs the einsum path first (PERF.md long-context rows)
@@ -317,11 +332,13 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
             training_steps=10_000,
             mu_dtype=knobs["mu_dtype"],
             nu_dtype=knobs["nu_dtype"],
+            param_dtype=knobs["param_dtype"],
         ),
         global_batch_size=batch_size,
     )
     state, sharding = create_sharded_state(
-        module, tx, batch, mesh, mode="pretrain"
+        module, tx, batch, mesh, mode="pretrain",
+        param_dtype=knobs["param_dtype"],
     )
     step = make_train_step(mesh, sharding, mode="pretrain")
     # Stage the batch on device once: training overlaps host→device copies
@@ -491,9 +508,11 @@ def _run_bench() -> dict:
         result["ms_step_f32"] = round(dt_f32 * 1e3, 2)
         _partial["vs_baseline"] = result["vs_baseline"]
         if batch_f32 != batch_size:
-            # The headline ratio folds batch-size efficiency into the dtype
-            # win. Time a bf16 leg AT the f32 batch too, so the artifact
-            # also carries the dtype-only (equal-batch) speedup.
+            # The headline ratio folds batch-size efficiency into the config
+            # win. Time a framework leg AT the f32 batch too, so the artifact
+            # also carries a framework-config vs reference-style ratio at
+            # equal batch (the framework leg keeps its tuned per-model knobs
+            # — gather/remat/moment dtypes — so this is NOT dtype-only).
             result["f32_batch"] = batch_f32
             dt_eq = _measure_leg("bfloat16", batch_f32, model, iters)
             result["vs_baseline_equal_batch"] = round(dt_f32 / dt_eq, 3)

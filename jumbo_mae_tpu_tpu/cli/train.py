@@ -398,17 +398,29 @@ def train(cfg: TrainConfig) -> dict:
         mode="pretrain" if run.mode == "pretrain" else "classify",
         init_seed=run.init_seed,
         rng_seed=run.seed,
+        param_dtype=cfg.optim.param_dtype,
     )
 
-    if run.pretrained_ckpt:
-        state = state.replace(
-            params=load_pretrained_params(run.pretrained_ckpt, state.params)
-        )
-
     ckpt = Checkpointer(cfg.checkpoint_config())
+    resuming = run.resume and ckpt.latest_step() is not None
+    if run.pretrained_ckpt and not resuming:
+        # (skipped on resume: the checkpoint restore below overwrites params
+        # AND opt_state anyway — re-doing the merge + a full jitted tx.init
+        # would only cost startup time and a transient opt-state allocation)
+        merged = load_pretrained_params(run.pretrained_ckpt, state.params)
+        # Optimizer state derives from the params at tx.init time — re-init
+        # so anything param-coupled follows the merge (critical with
+        # optim.param_dtype: the f32 master copy in opt_state would
+        # otherwise still hold the random init and the first step would
+        # overwrite the warm start with master-derived values).
+        opt_state = jax.jit(
+            state.tx.init, out_shardings=state_sharding.opt_state
+        )(merged)
+        state = state.replace(params=merged, opt_state=opt_state)
+
     start_step = 0
     data_cursor = None
-    if run.resume and ckpt.latest_step() is not None:
+    if resuming:
         state, extra = ckpt.restore(state, sharding=state_sharding)
         start_step = int(state.step)
         data_cursor = extra.get("data_cursor")

@@ -245,6 +245,7 @@ class Checkpointer:
             )
         tmpl, _ = split_rng_for_save(template)
         abstract = abstract_state(tmpl, sharding)
+        _warn_on_dtype_casts(mgr, step, abstract)
         out = mgr.restore(
             step,
             args=ocp.args.Composite(
@@ -264,6 +265,56 @@ class Checkpointer:
         self.wait()
         self._last.close()
         self._best.close()
+
+
+def _leaf_dtype_map(tree) -> dict[str, Any]:
+    """Flatten a pytree to {"a/b/c": dtype} keyed by path *names* only, so a
+    flax-struct state and Orbax's dict-shaped metadata compare likewise."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        names = []
+        for k in path:
+            name = getattr(k, "key", None)
+            if name is None:
+                name = getattr(k, "name", None)
+            if name is None:
+                name = getattr(k, "idx", None)
+            names.append(str(name) if name is not None else str(k))
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None:
+            out["/".join(names)] = jnp.dtype(dt)
+    return out
+
+
+def _warn_on_dtype_casts(mgr, step, abstract):
+    """Abstract-template restore silently casts any saved array whose dtype
+    differs from the template (e.g. resuming an f32-moment checkpoint with an
+    ``optim.nu_dtype=bfloat16`` recipe changes numerics mid-run). Surface
+    that, best-effort — metadata layouts vary across Orbax versions."""
+    try:
+        meta = mgr.item_metadata(step)["state"]
+        saved = _leaf_dtype_map(meta)
+        want = _leaf_dtype_map(abstract)
+        casts = {
+            p: (saved[p], want[p])
+            for p in want
+            if p in saved and saved[p] != want[p]
+        }
+        if casts:
+            shown = sorted(casts)[:8]
+            detail = ", ".join(
+                f"{p}: {casts[p][0]}→{casts[p][1]}" for p in shown
+            )
+            more = len(casts) - len(shown)
+            print(
+                f"[checkpoint] WARNING: restore is casting {len(casts)} "
+                f"array(s) to the template dtype ({detail}"
+                + (f", +{more} more" if more > 0 else "")
+                + ") — numerics change mid-run; align the recipe's "
+                "mu/nu/param dtypes with the checkpoint if unintended"
+            )
+    except Exception:
+        pass
 
 
 # --------------------------------------------------------------------------

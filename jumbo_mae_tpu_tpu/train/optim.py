@@ -20,7 +20,7 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass
 from functools import partial
-from typing import Literal
+from typing import Any, Literal, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +57,14 @@ class OptimConfig:
     # for bandwidth-bound large models (PERF.md §ViT-H/14), never a silent
     # default.
     nu_dtype: str | None = None
+    # Storage dtype for the *parameters* (forward/backward weight reads).
+    # "bfloat16" halves weight HBM traffic — the lever that matters when the
+    # same weights are re-read many times per step (the shared jumbo MLP, the
+    # constant-size decoder). The optimizer keeps a float32 master copy in
+    # its state and computes the update in float32; the bf16 params are an
+    # exact cast of the master after every step, so optimizer numerics are
+    # full-precision and only the forward sees rounded weights. Opt-in.
+    param_dtype: str | None = None
 
     def peak_lr(self, global_batch_size: int) -> float:
         if self.lr_scaling == "batch":
@@ -156,6 +164,47 @@ def scale_by_adam_dtyped(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+class MasterWeightsState(NamedTuple):
+    """float32 master copy of the params + the wrapped optimizer's state."""
+
+    master: Any
+    inner: Any
+
+
+def with_master_weights(
+    inner: optax.GradientTransformation, master_dtype=jnp.float32
+) -> optax.GradientTransformation:
+    """Run ``inner`` against a float32 master copy of low-precision params.
+
+    The returned transformation's update is ``new_master - params`` computed
+    in ``master_dtype``; ``optax.apply_updates`` promotes ``params`` to the
+    update dtype before adding, so the stored low-precision params are an
+    EXACT downcast of the master after every step (covered by a test). The
+    sharding rules in ``parallel/sharding.py`` match on trailing path names,
+    so the master tree inherits the params' FSDP/TP layout automatically.
+    """
+    master_dtype = jnp.dtype(master_dtype)
+
+    def init_fn(params):
+        master = jax.tree.map(lambda p: p.astype(master_dtype), params)
+        return MasterWeightsState(master=master, inner=inner.init(master))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("with_master_weights requires params")
+        grads = jax.tree.map(lambda g: g.astype(master_dtype), updates)
+        inner_updates, inner_state = inner.update(
+            grads, state.inner, state.master
+        )
+        new_master = optax.apply_updates(state.master, inner_updates)
+        out = jax.tree.map(
+            lambda m, p: m - p.astype(master_dtype), new_master, params
+        )
+        return out, MasterWeightsState(master=new_master, inner=inner_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def _scale_by_adam(b1, b2, eps, mu_dtype=None, nu_dtype=None):
     """Stock optax unless ``nu_dtype`` forces the dtyped variant."""
     if nu_dtype:
@@ -236,6 +285,8 @@ def make_optimizer(
             tx = optax.chain(tx, optax.multi_transform(scales, label_fn))
         if cfg.clip_grad > 0:
             tx = optax.chain(optax.clip_by_global_norm(cfg.clip_grad), tx)
+        if cfg.param_dtype and jnp.dtype(cfg.param_dtype) != jnp.float32:
+            tx = with_master_weights(tx)
         return tx
 
     return build(make_schedule(cfg, global_batch_size))

@@ -72,11 +72,16 @@ def create_sharded_state(
     init_seed: int = 0,
     rng_seed: int = 0,
     min_shard_size: int = 2**16,
+    param_dtype: str | None = None,
 ) -> tuple[TrainState, Any]:
     """Initialize a TrainState directly into its mesh sharding.
 
     Returns ``(state, state_sharding)``; the sharding tree is reused by the
     step factories and the checkpoint manager.
+
+    ``param_dtype`` casts the stored params after init (e.g. "bfloat16" for
+    half weight-read HBM traffic); pair it with ``optim.param_dtype`` so the
+    optimizer keeps a float32 master copy (``with_master_weights``).
     """
     inputs = _model_inputs(mode, example_batch)
     init_rngs = {
@@ -89,9 +94,13 @@ def create_sharded_state(
 
     def init_fn():
         variables = module.init(init_rngs, *inputs)
+        params = variables["params"]
+        if param_dtype is not None:
+            dt = jnp.dtype(param_dtype)
+            params = jax.tree_util.tree_map(lambda p: p.astype(dt), params)
         return TrainState.create(
             apply_fn=module.apply,
-            params=variables["params"],
+            params=params,
             tx=tx,
             batch_stats=variables.get("batch_stats"),
             rng=make_base_rng(rng_seed),
@@ -168,8 +177,12 @@ def make_train_step(
                     state,
                 )[1][0]
             )
+            # Accumulate in float32 even when params (and so grads) are
+            # bf16-stored: micro-grad sums lose mantissa fast in bf16.
             init = (
-                jax.tree_util.tree_map(jnp.zeros_like, state.params),
+                jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+                ),
                 jax.tree_util.tree_map(
                     lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
                 ),
@@ -181,6 +194,9 @@ def make_train_step(
                 idx, micro_batch = xs
                 (_, (metrics, new_stats)), grads = grad_fn(
                     state.params, stats, idx, micro_batch, state
+                )
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads
                 )
                 return (
                     _tree_add(grads_acc, grads),

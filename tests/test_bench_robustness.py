@@ -154,6 +154,7 @@ def test_leg_config_f32_leg_is_env_proof():
         dec_remat=None,
         mu_dtype=None,
         nu_dtype=None,
+        param_dtype=None,
         attn_impl="auto",
     )
 
@@ -170,8 +171,20 @@ def test_leg_config_bf16_defaults_and_overrides():
         dec_remat=None,
         mu_dtype="bfloat16",
         nu_dtype="bfloat16",
+        param_dtype=None,
         attn_impl="auto",
     )
+    # param storage dtype: env-only knob until an A/B promotes a default;
+    # "float32" is the explicit off-spelling and normalizes to None
+    got = bench.leg_config("vit_h14", "bfloat16", env={"BENCH_PARAM_DTYPE": "bfloat16"})
+    assert got["param_dtype"] == "bfloat16"
+    got = bench.leg_config("vit_h14", "bfloat16", env={"BENCH_PARAM_DTYPE": "float32"})
+    assert got["param_dtype"] is None
+    # malformed BENCH_REMAT dies with a clear message, not a ValueError
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit, match="BENCH_REMAT"):
+        bench.leg_config("vit_h14", "bfloat16", env={"BENCH_REMAT": "true"})
     # explicit off-spellings flip every default-on knob back off
     off = {
         "BENCH_REMAT": "1",

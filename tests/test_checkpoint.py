@@ -152,6 +152,44 @@ def test_msgpack_roundtrip(tmp_path, mesh):
         np.testing.assert_array_equal(a, b)
 
 
+def test_restore_warns_on_optimizer_dtype_cast(tmp_path, mesh, capsys):
+    """Resuming an f32-moment checkpoint with a bf16-moment template silently
+    casts the moments (abstract-template restore); the restore path must
+    surface that. Pins the Orbax item_metadata integration — if an Orbax
+    upgrade changes the metadata layout, this test (not a user's silent
+    mid-run numerics change) is what breaks."""
+    state, sharding, _, batch = build(mesh)
+    ckpt = Checkpointer(CheckpointConfig(str(tmp_path), async_save=False))
+    ckpt.save(0, state, metrics={"val/loss": 1.0})
+    ckpt.wait()
+    module = MAEPretrainModel(TINY, TINY_DEC)
+    tx_cast = make_optimizer(
+        OptimConfig(
+            name="adamw", learning_rate=1e-3, lr_scaling="none",
+            warmup_steps=2, training_steps=20, nu_dtype="bfloat16",
+        ),
+        global_batch_size=16,
+    )
+    tmpl, tmpl_sharding = create_sharded_state(
+        module, tx_cast, batch, mesh, mode="pretrain", min_shard_size=128
+    )
+    capsys.readouterr()
+    restored, _ = ckpt.restore(tmpl, sharding=tmpl_sharding)
+    out = capsys.readouterr().out
+    ckpt.close()
+    assert "WARNING: restore is casting" in out, out
+    assert "nu" in out
+    # and the same-dtype restore stays quiet
+    ckpt2 = Checkpointer(CheckpointConfig(str(tmp_path / "b"), async_save=False))
+    ckpt2.save(0, state, metrics={"val/loss": 1.0})
+    ckpt2.wait()
+    capsys.readouterr()
+    ckpt2.restore(state, sharding=sharding)
+    out = capsys.readouterr().out
+    ckpt2.close()
+    assert "WARNING: restore is casting" not in out, out
+
+
 def test_resize_posemb():
     grid = np.random.RandomState(0).rand(1, 4, 4, 8).astype(np.float32)
     out = resize_posemb(grid, (1, 8, 8, 8))
