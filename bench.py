@@ -191,6 +191,25 @@ MODELS = {
 }
 
 
+def _parse_dec_heads(value, dec_dim: int) -> int:
+    """Eager validation (leg_config contract: bad knobs die with a clear
+    message BEFORE anything is measured): must be an int dividing the
+    decoder dim, else head_dim would silently floor and the bench would
+    record numbers for a different attention than the config claims."""
+    try:
+        heads = int(value or 0)
+    except (TypeError, ValueError):
+        raise SystemExit(
+            f"BENCH_DEC_HEADS={value!r} not an integer"
+        ) from None
+    if heads and dec_dim % heads:
+        raise SystemExit(
+            f"BENCH_DEC_HEADS={heads} does not divide the decoder dim "
+            f"{dec_dim}"
+        )
+    return heads
+
+
 def _norm_f32(value):
     """Map the explicit "float32" off-spelling (and unset) to None so the
     master-weights wrapper only engages for real low-precision storage."""
@@ -253,6 +272,13 @@ def leg_config(model: str, dtype: str, env=None) -> dict:
         # flash kernel avoids materializing the O(S^2) score tensor, which
         # is what OOMs the einsum path first (PERF.md long-context rows)
         attn_impl=knob("BENCH_ATTN_IMPL", "auto"),
+        # decoder head-count override (head_dim = 512/heads): heads=8 gives
+        # head_dim 64 — the MAE paper's 16h decoder is a recipe choice, and
+        # at B scale the d32 decoder attention is the profile's top target
+        dec_heads=_parse_dec_heads(
+            knob("BENCH_DEC_HEADS", leg.get("dec_heads", 0)),
+            spec["dec"]["dim"],
+        ),
     )
     if out["attn_impl"] not in ("einsum", "flash", "ring", "auto"):
         # the model's dispatch would silently fall back to einsum and the
@@ -308,8 +334,11 @@ def build_step(dtype: str, batch_size: int, model: str = "vit_l16"):
         attn_impl=knobs["attn_impl"],
     )
     dec_remat = knobs["dec_remat"]
+    dec_spec = dict(spec["dec"])
+    if knobs["dec_heads"]:
+        dec_spec["heads"] = knobs["dec_heads"]
     dec = DecoderConfig(
-        **spec["dec"],
+        **dec_spec,
         dtype=dtype,
         attn_impl=knobs["attn_impl"],
         grad_ckpt=bool(dec_remat),

@@ -21,6 +21,7 @@ import time
 from pathlib import Path
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from jumbo_mae_tpu_tpu.config import (
@@ -383,7 +384,28 @@ def train(cfg: TrainConfig) -> dict:
     per_process = run.train_batch_size // process_count
     per_process_valid = max(1, run.valid_batch_size // process_count)
 
-    mesh = create_mesh(cfg.mesh)
+    cfg.mesh.validate_pipe()
+    pipe_microbatches = 0
+    if cfg.mesh.pipe > 1:
+        if run.mode != "pretrain":
+            raise ValueError("mesh.pipe is wired for run.mode=pretrain only")
+        from jumbo_mae_tpu_tpu.parallel import create_pipeline_mesh
+
+        n_dev = len(jax.devices())
+        pipe_data = cfg.mesh.data
+        if pipe_data == -1:
+            pipe_data = max(1, n_dev // cfg.mesh.pipe)
+        if pipe_data * cfg.mesh.pipe < n_dev:
+            print(
+                f"[mesh] WARNING: mesh data={pipe_data} x pipe="
+                f"{cfg.mesh.pipe} uses {pipe_data * cfg.mesh.pipe} of "
+                f"{n_dev} devices; set mesh.data=-1 (or explicitly) to "
+                "cover the rest"
+            )
+        mesh = create_pipeline_mesh(data=pipe_data, pipe=cfg.mesh.pipe)
+        pipe_microbatches = cfg.mesh.pipe_microbatches or cfg.mesh.pipe
+    else:
+        mesh = create_mesh(cfg.mesh)
     model, enc_cfg, flops_per_image = build_model(cfg)
     tx = make_optimizer(
         cfg.optim, run.train_batch_size, num_layers=enc_cfg.layers
@@ -407,7 +429,22 @@ def train(cfg: TrainConfig) -> dict:
         # (skipped on resume: the checkpoint restore below overwrites params
         # AND opt_state anyway — re-doing the merge + a full jitted tx.init
         # would only cost startup time and a transient opt-state allocation)
-        merged = load_pretrained_params(run.pretrained_ckpt, state.params)
+        # With low-precision param storage, merge into an f32 template so
+        # the master copy keeps the checkpoint's full precision (merging
+        # straight into bf16 params would quantize the master at init);
+        # stored params are then the downcast, per the master-weights
+        # contract.
+        low_precision = cfg.optim.param_dtype and jnp.dtype(
+            cfg.optim.param_dtype
+        ) != jnp.float32
+        template = (
+            jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), state.params
+            )
+            if low_precision
+            else state.params
+        )
+        merged = load_pretrained_params(run.pretrained_ckpt, template)
         # Optimizer state derives from the params at tx.init time — re-init
         # so anything param-coupled follows the merge (critical with
         # optim.param_dtype: the f32 master copy in opt_state would
@@ -416,6 +453,10 @@ def train(cfg: TrainConfig) -> dict:
         opt_state = jax.jit(
             state.tx.init, out_shardings=state_sharding.opt_state
         )(merged)
+        if low_precision:
+            merged = jax.tree_util.tree_map(
+                lambda m, p: m.astype(p.dtype), merged, state.params
+            )
         state = state.replace(params=merged, opt_state=opt_state)
 
     start_step = 0
@@ -428,7 +469,12 @@ def train(cfg: TrainConfig) -> dict:
 
     mode_key = "pretrain" if run.mode == "pretrain" else "classify"
     train_step = make_train_step(
-        mesh, state_sharding, mode=mode_key, grad_accum=run.grad_accum
+        mesh,
+        state_sharding,
+        mode=mode_key,
+        grad_accum=run.grad_accum,
+        pipe_microbatches=pipe_microbatches,
+        encoder_cfg=enc_cfg if pipe_microbatches else None,
     )
     eval_step = make_eval_step(mesh, state_sharding, mode=mode_key)
 

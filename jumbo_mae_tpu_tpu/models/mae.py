@@ -107,13 +107,17 @@ class MAEPretrainModel(nn.Module):
         return_reconstruction: bool = False,
         *,
         mask_noise: jax.Array | None = None,
+        blocks_override=None,
     ):
         enc_cfg = self.encoder_cfg
         k = enc_cfg.num_cls_tokens
         images = normalize_images(images, dtype=enc_cfg.compute_dtype)
 
         tokens, mask, ids_restore = self.encoder(
-            images, deterministic, mask_noise=mask_noise
+            images,
+            deterministic,
+            mask_noise=mask_noise,
+            blocks_override=blocks_override,
         )
         tokens = self.decoder_proj(tokens)
         cls, visible = tokens[:, :k, :], tokens[:, k:, :]

@@ -70,7 +70,14 @@ class JumboViT(nn.Module):
         deterministic: bool = True,
         *,
         mask_noise: jax.Array | None = None,
+        blocks_override=None,
     ):
+        """``blocks_override`` (optional callable ``tokens -> tokens``)
+        replaces the sequential block chain — the seam the pipeline-parallel
+        train step uses to run the same ``block_*`` parameters through the
+        GPipe schedule (``parallel/pipeline.py``) instead of the Python
+        loop. The override closes over the parameter tree at the step level,
+        so gradients flow through it unchanged."""
         cfg = self.cfg
         k = cfg.num_cls_tokens
         x = self.embed(images)
@@ -94,8 +101,11 @@ class JumboViT(nn.Module):
         x = jnp.concatenate([cls, x], axis=1)
         x = self.drop(x, deterministic)
 
-        for block in self.blocks:
-            x = block(x, deterministic)
+        if blocks_override is not None:
+            x = blocks_override(x)
+        else:
+            for block in self.blocks:
+                x = block(x, deterministic)
         x = self.norm(x)
 
         if self.mae_mode:

@@ -29,12 +29,29 @@ AXES = ("data", "fsdp", "tensor", "seq")
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """Axis sizes; -1 on ``fsdp`` means "all remaining devices"."""
+    """Axis sizes; -1 on ``fsdp`` means "all remaining devices".
+
+    ``pipe > 1`` selects pipeline parallelism instead: the runtime builds a
+    ``(data, pipe)`` mesh (``create_pipeline_mesh``) and streams
+    ``pipe_microbatches`` microbatches through the GPipe schedule
+    (``parallel/pipeline.py``). Mutually exclusive with fsdp/tensor/seq > 1.
+    """
 
     data: int = 1
     fsdp: int = -1
     tensor: int = 1
     seq: int = 1
+    pipe: int = 1
+    pipe_microbatches: int = 0  # 0 → defaults to the pipe size
+
+    def validate_pipe(self) -> None:
+        if self.pipe > 1 and any(
+            s not in (1, -1) for s in (self.fsdp, self.tensor, self.seq)
+        ):
+            raise ValueError(
+                "mesh.pipe composes with mesh.data only; set fsdp/tensor/seq "
+                "to 1 (pipeline + FSDP/TP/SP composition is not wired)"
+            )
 
     def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
         sizes = [self.data, self.fsdp, self.tensor, self.seq]

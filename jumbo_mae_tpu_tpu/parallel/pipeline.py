@@ -174,6 +174,53 @@ def gpipe(
     return out.reshape(batch, *x.shape[1:])
 
 
+def make_jumbo_pipeline_apply(
+    cfg, *, mesh: Mesh, microbatches: int
+) -> Callable[[dict, jax.Array], jax.Array]:
+    """Build ``apply(encoder_params, x) -> x`` that pipelines a JumboViT
+    encoder's ``block_*`` chain with the shared jumbo CLS MLP replicated
+    across stages.
+
+    The standalone block module is constructed HERE, at factory time —
+    constructing flax modules inside another module's apply (e.g. from the
+    ``blocks_override`` seam) is an ``AssignSubModuleError``.
+
+    ``encoder_params`` is the encoder subtree of a real model
+    (``block_0…block_{L-1}`` + ``jumbo_mlp`` + embed/ln/… — only the
+    blocks and ``jumbo_mlp`` are read). ``x`` is the token sequence after
+    embedding/CLS concat, i.e. the input to ``block_0``.
+    """
+    from jumbo_mae_tpu_tpu.models.config import maybe_remat
+    from jumbo_mae_tpu_tpu.models.layers import JumboBlock, make_jumbo_mlp
+
+    # name=None: a standalone block scopes the shared MLP under itself
+    # via its attribute name, and we graft the shared params in per call.
+    # maybe_remat: the pipeline must honor cfg.grad_ckpt like the
+    # sequential encoder does — GPipe holds every in-flight microbatch's
+    # activations, so dropping remat here would silently change the memory
+    # profile of exactly the configs pipeline parallelism targets.
+    block = maybe_remat(JumboBlock, cfg)(cfg, make_jumbo_mlp(cfg, name=None))
+
+    def apply(encoder_params: dict, x: jax.Array) -> jax.Array:
+        stacked, _ = stack_block_params(encoder_params)
+
+        def block_fn(p, h, shared):
+            # a standalone JumboBlock scopes the shared MLP under itself; the
+            # encoder scopes it at the parent — graft it in per call
+            return block.apply({"params": {**p, "jumbo_mlp": shared}}, h, True)
+
+        return gpipe(
+            block_fn,
+            stacked,
+            x,
+            mesh=mesh,
+            microbatches=microbatches,
+            shared_params=encoder_params["jumbo_mlp"],
+        )
+
+    return apply
+
+
 def pipelined_jumbo_blocks_apply(
     cfg,
     encoder_params: dict,
@@ -182,33 +229,11 @@ def pipelined_jumbo_blocks_apply(
     mesh: Mesh,
     microbatches: int,
 ) -> jax.Array:
-    """Pipeline a JumboViT encoder's ``block_*`` chain, with the shared
-    jumbo CLS MLP replicated across stages.
-
-    ``encoder_params`` is the encoder subtree of a real model
-    (``block_0…block_{L-1}`` + ``jumbo_mlp`` + embed/ln/… — only the
-    blocks and ``jumbo_mlp`` are read). ``x`` is the token sequence after
-    embedding/CLS concat, i.e. the input to ``block_0``.
-    """
-    from jumbo_mae_tpu_tpu.models.layers import JumboBlock, make_jumbo_mlp
-
-    # name=None: a standalone block scopes the shared MLP under itself
-    # via its attribute name, and we graft the shared params in per call
-    block = JumboBlock(cfg, make_jumbo_mlp(cfg, name=None))
-    stacked, _ = stack_block_params(encoder_params)
-
-    def block_fn(p, h, shared):
-        # a standalone JumboBlock scopes the shared MLP under itself; the
-        # encoder scopes it at the parent — graft it in per call
-        return block.apply({"params": {**p, "jumbo_mlp": shared}}, h, True)
-
-    return gpipe(
-        block_fn,
-        stacked,
-        x,
-        mesh=mesh,
-        microbatches=microbatches,
-        shared_params=encoder_params["jumbo_mlp"],
+    """One-shot convenience over :func:`make_jumbo_pipeline_apply` (module
+    construction happens per call — use the factory from inside train
+    steps)."""
+    return make_jumbo_pipeline_apply(cfg, mesh=mesh, microbatches=microbatches)(
+        encoder_params, x
     )
 
 

@@ -101,7 +101,7 @@ def shard_param_spec(
     if tp_dim is not None:
         spec[tp_dim] = tensor_axis
 
-    size = mesh.shape[axis]
+    size = mesh.shape.get(axis, 1)  # e.g. ("data","pipe") pipeline meshes
     if size > 1 and int(np.prod(shape)) >= min_shard_size:
         candidates = [
             i
@@ -147,5 +147,5 @@ def batch_sharding(
     """Shard the leading (batch) dim over the data-parallel axes. With
     ``accum=True`` the batch is (accum, micro, ...): dim 0 stays replicated
     and dim 1 (micro batch) is sharded."""
-    axes = tuple(a for a in leading_axes if mesh.shape[a] > 1) or None
+    axes = tuple(a for a in leading_axes if mesh.shape.get(a, 1) > 1) or None
     return NamedSharding(mesh, P(None, axes) if accum else P(axes))

@@ -118,17 +118,49 @@ def make_train_step(
     *,
     mode: Mode,
     grad_accum: int = 1,
+    pipe_microbatches: int = 0,
+    encoder_cfg: Any = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step.
 
     ``grad_accum == 1``: batch leaves are (batch, ...).
     ``grad_accum > 1``: batch leaves are (accum, micro, ...) and a
     ``lax.scan`` accumulates gradients before the single optimizer update.
+
+    ``pipe_microbatches > 0`` (pretrain only, requires ``encoder_cfg`` and a
+    mesh with a ``pipe`` axis): the encoder's block chain runs through the
+    GPipe schedule (``parallel/pipeline.py``) via the model's
+    ``blocks_override`` seam — same parameters, pipelined execution.
     """
+    if pipe_microbatches:
+        if mode != "pretrain":
+            raise ValueError("pipeline parallelism is wired for pretrain only")
+        if encoder_cfg is None:
+            raise ValueError("pipe_microbatches requires encoder_cfg")
+        if "pipe" not in mesh.shape:
+            raise ValueError("pipe_microbatches requires a mesh with a 'pipe' axis")
+        if (encoder_cfg.dropout or 0) > 0 or (encoder_cfg.droppath or 0) > 0:
+            # gpipe applies blocks deterministically (no per-stage rng
+            # plumbing); droppath/dropout would silently become no-ops
+            raise ValueError(
+                "the pipeline-parallel path runs blocks deterministically; "
+                "set encoder dropout/droppath to 0"
+            )
+        from jumbo_mae_tpu_tpu.parallel.pipeline import (
+            make_jumbo_pipeline_apply,
+        )
+
+        pipeline_apply = make_jumbo_pipeline_apply(
+            encoder_cfg, mesh=mesh, microbatches=pipe_microbatches
+        )
 
     def loss_fn(params, batch_stats, micro_idx, batch, state):
         rngs = state.step_rngs(micro=micro_idx)
         variables = {"params": params}
+        extra = {}
+        if pipe_microbatches:
+            enc_params = params["encoder"]
+            extra["blocks_override"] = lambda x: pipeline_apply(enc_params, x)
         new_stats = None
         if batch_stats is not None:
             variables["batch_stats"] = batch_stats
@@ -138,6 +170,7 @@ def make_train_step(
                 deterministic=False,
                 rngs=rngs,
                 mutable=["batch_stats"],
+                **extra,
             )
             new_stats = updated["batch_stats"]
         else:
@@ -146,6 +179,7 @@ def make_train_step(
                 *_model_inputs(mode, batch),
                 deterministic=False,
                 rngs=rngs,
+                **extra,
             )
         metrics = {
             k: v.mean() if v.ndim else v

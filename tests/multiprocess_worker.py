@@ -167,9 +167,92 @@ def run_leg(shards: str) -> dict:
     return {"losses": losses, "val": val, "cursor": cursor}
 
 
+def build_fsdp(mesh=None):
+    """(state, state_sharding, train_step, mesh) on a data=2 × fsdp=4 mesh
+    over 8 global devices — identical in every topology (the single-process
+    test leg and the 2-proc × 4-device workers build the same thing)."""
+    import jax
+
+    from jumbo_mae_tpu_tpu.parallel import MeshConfig, create_mesh
+    from jumbo_mae_tpu_tpu.models import ClassificationModel, preset
+    from jumbo_mae_tpu_tpu.train import (
+        OptimConfig,
+        create_sharded_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    if mesh is None:
+        mesh = create_mesh(MeshConfig(data=2, fsdp=4), devices=jax.devices()[:8])
+    model = ClassificationModel(
+        preset(
+            "vit_t16", image_size=IMAGE, patch_size=16, labels=LABELS,
+            mask_ratio=None, dtype="float32",
+        )
+    )
+    tx = make_optimizer(
+        OptimConfig(
+            name="adamw", learning_rate=1e-3, lr_scaling="none",
+            warmup_steps=1, training_steps=TRAIN_STEPS + 1,
+        ),
+        global_batch_size=GLOBAL_BATCH,
+    )
+    example = {
+        "images": np.zeros((GLOBAL_BATCH, IMAGE, IMAGE, 3), np.uint8),
+        "labels": np.zeros((GLOBAL_BATCH,), np.int32),
+    }
+    # min_shard_size=128 so the tiny model's params REALLY shard over fsdp
+    state, state_sharding = create_sharded_state(
+        model, tx, example, mesh, mode="classify", min_shard_size=128
+    )
+    train_step = make_train_step(mesh, state_sharding, mode="classify")
+    return state, state_sharding, train_step, mesh
+
+
+def run_leg_fsdp(ckpt_dir: str) -> dict:
+    """DP×FSDP leg over 8 global devices (VERDICT r3 item 4: the actual
+    pod-slice composition — multiple processes × multiple devices per
+    process × parameter sharding). Trains 3 steps on striped global batches
+    and Orbax-saves the full sharded state; the test restores it under a
+    DIFFERENT process topology and checks it equals the single-process run.
+    """
+    import jax
+
+    from jumbo_mae_tpu_tpu.parallel import batch_sharding
+    from jumbo_mae_tpu_tpu.data import prefetch_to_device
+    from jumbo_mae_tpu_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+
+    n, pid = jax.process_count(), jax.process_index()
+    state, state_sharding, train_step, mesh = build_fsdp()
+    specs = {
+        str(s.spec)
+        for s in jax.tree_util.tree_leaves(state_sharding.params)
+    }
+    assert any("fsdp" in s for s in specs), specs
+    sharding = batch_sharding(mesh, accum=False)
+
+    per = GLOBAL_BATCH // n
+
+    def stripes():
+        for step in range(TRAIN_STEPS):
+            g = global_train_batch(step)
+            yield {k: v[pid * per : (pid + 1) * per] for k, v in g.items()}
+
+    losses = []
+    for batch in prefetch_to_device(stripes(), sharding):
+        state, metrics = train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+
+    ckpt = Checkpointer(CheckpointConfig(ckpt_dir, async_save=False))
+    ckpt.save(TRAIN_STEPS, state, metrics={"val/loss": losses[-1]})
+    ckpt.close()
+    return {"losses": losses, "fsdp_param_specs": sorted(specs)}
+
+
 def main():
     pid, n, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     outdir, shards = sys.argv[4], sys.argv[5]
+    mode = sys.argv[6] if len(sys.argv) > 6 else "dp"
 
     import jax
 
@@ -178,7 +261,11 @@ def main():
         f"127.0.0.1:{port}", num_processes=n, process_id=pid
     )
     assert jax.process_count() == n
-    result = run_leg(shards) | {"pid": pid, "n_devices": len(jax.devices())}
+    if mode == "fsdp":
+        result = run_leg_fsdp(os.path.join(outdir, "ckpt"))
+    else:
+        result = run_leg(shards)
+    result |= {"pid": pid, "n_devices": len(jax.devices())}
     with open(os.path.join(outdir, f"proc{pid}.json"), "w") as f:
         json.dump(result, f)
     jax.distributed.shutdown()

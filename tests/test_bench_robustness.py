@@ -156,6 +156,7 @@ def test_leg_config_f32_leg_is_env_proof():
         nu_dtype=None,
         param_dtype=None,
         attn_impl="auto",
+        dec_heads=0,
     )
 
 
@@ -173,6 +174,7 @@ def test_leg_config_bf16_defaults_and_overrides():
         nu_dtype="bfloat16",
         param_dtype=None,
         attn_impl="auto",
+        dec_heads=0,
     )
     # param storage dtype: env-only knob until an A/B promotes a default;
     # "float32" is the explicit off-spelling and normalizes to None

@@ -1,0 +1,326 @@
+"""End-to-end learning evidence (VERDICT r3 item 1).
+
+Two claims nothing else in the suite supports:
+
+1. **Training dynamics parity over hundreds of steps** — the reference's own
+   pmap ``training_step`` and this framework's jit step, fed identical data
+   and identical mask permutations (extracted per step from the reference's
+   RNG stream via the ``bind`` replay trick of
+   ``tests/test_reference_parity.py``), produce the same loss curve
+   step-for-step. A defect anywhere in the optimizer chain, LR schedule,
+   weight-decay masking, or model gradients would compound and diverge the
+   curves; 10-step smoke tests cannot see that.
+
+2. **Pretraining learns transferable representations** — MAE-pretrain a tiny
+   JumboViT on the procedural toy distribution (``data/toy.py``) through the
+   real recipe machinery (CLI ``train()``, tar shards, real loaders), then
+   linear-probe the frozen encoder with the real probe recipe, and compare
+   against probing a random-init encoder. The margin is the framework-scale
+   analog of the reference's ImageNet linear-probe table
+   (``/root/reference/README.md:10-13``) — the reference's entire QA story.
+
+Both are slow (minutes each on CPU) and ``slow``-marked.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+IMAGE, PATCH = 64, 16
+LAYERS, DIM, HEADS = 2, 48, 4
+BATCH = 8
+STEPS = 200
+# base LR chosen so the reference's hardwired peak = lr·batch/256 lands at
+# 1e-3 — enough to visibly learn in 200 steps at this scale
+LR, WD, B2, WARMUP = 3.2e-2, 0.05, 0.95, 20
+
+
+@pytest.fixture(scope="module")
+def ref():
+    """Reference modules with dependency stubs (same shim as
+    tests/test_reference_parity.py)."""
+    import sys
+    import types
+
+    injected = [m for m in ("webdataset", "dataset") if m not in sys.modules]
+    if "webdataset" in injected:
+        sys.modules["webdataset"] = types.ModuleType("webdataset")
+    if "dataset" in injected:
+        ds = types.ModuleType("dataset")
+        ds.IMAGENET_DEFAULT_MEAN = np.array([0.485, 0.456, 0.406])
+        ds.IMAGENET_DEFAULT_STD = np.array([0.229, 0.224, 0.225])
+        sys.modules["dataset"] = ds
+    # the reference targets an older jax: give it back the removed alias
+    had_tree_map = hasattr(jax, "tree_map")
+    if not had_tree_map:
+        jax.tree_map = jax.tree_util.tree_map
+    sys.path.insert(0, "/root/reference/src")
+    try:
+        import pretraining as ref_pretraining
+
+        yield ref_pretraining
+    finally:
+        if not had_tree_map:
+            del jax.tree_map
+        sys.path.remove("/root/reference/src")
+        for m in injected + ["modeling", "pretraining", "utils", "utils_mae"]:
+            sys.modules.pop(m, None)
+
+
+def _ref_args() -> argparse.Namespace:
+    """The argparse surface create_train_state consumes
+    (/root/reference/src/pretraining.py:170-270), at test scale."""
+    return argparse.Namespace(
+        layers=LAYERS, dim=DIM, heads=HEADS, labels=-1,
+        layerscale=True, patch_size=PATCH, image_size=IMAGE,
+        posemb="sincos2d", pooling="cls", dropout=0.0, droppath=0.0,
+        grad_ckpt=False, image_mask_ratio=0.75,
+        dec_layers=2, dec_dim=32, dec_heads=4, dec_layerscale=True,
+        dec_posemb="sincos2d", dec_dropout=0.0, dec_droppath=0.0,
+        norm_pix_loss=True,
+        optimizer="adamw", adam_b1=0.9, adam_b2=B2, adam_eps=1e-8,
+        weight_decay=WD, lr_decay=1.0, clip_grad=0.0,
+        learning_rate=LR, train_batch_size=BATCH,
+        warmup_steps=WARMUP, training_steps=STEPS,
+        init_seed=11, mixup_seed=12, dropout_seed=13, noise_seed=14,
+        grad_accum=1,
+    )
+
+
+def test_reference_training_dynamics_parity(ref):
+    """200 optimizer steps: reference pmap trainer vs this framework's step
+    under identical data + masks → same loss curve."""
+    from jumbo_mae_tpu_tpu.interop import reference_pretrain_to_jumbo
+    from jumbo_mae_tpu_tpu.models import (
+        DecoderConfig,
+        JumboViTConfig,
+        MAEPretrainModel,
+    )
+    from jumbo_mae_tpu_tpu.train import OptimConfig, make_optimizer
+
+    args = _ref_args()
+    ref_state = ref.create_train_state(args)
+    ref_module_vars = {"params": ref_state.params}
+    ref_module = ref_state.apply_fn.__self__
+
+    # ---- this framework's side: converted init, same optimizer recipe ----
+    my_cfg = JumboViTConfig(
+        layers=LAYERS, dim=DIM, heads=HEADS, image_size=IMAGE,
+        patch_size=PATCH, layerscale=True, dtype="float32",
+        posemb="sincos2d", mask_ratio=0.75, labels=None,
+    )
+    my_module = MAEPretrainModel(
+        my_cfg,
+        DecoderConfig(layers=2, dim=32, heads=4, layerscale=True, dtype="float32"),
+        norm_pix_loss=True,
+    )
+    my_params = reference_pretrain_to_jumbo(
+        jax.device_get(ref_state.params)
+    )
+    tx = make_optimizer(
+        OptimConfig(
+            name="adamw", learning_rate=LR, lr_scaling="batch",
+            b1=0.9, b2=B2, eps=1e-8, weight_decay=WD,
+            warmup_steps=WARMUP, training_steps=STEPS,
+        ),
+        global_batch_size=BATCH,
+    )
+    my_opt_state = tx.init(my_params)
+
+    @jax.jit
+    def my_step(params, opt_state, images_nhwc, mask_noise):
+        def loss_fn(p):
+            out = my_module.apply(
+                {"params": p}, images_nhwc, deterministic=False,
+                mask_noise=mask_noise,
+            )
+            return out["loss"]
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # ---- drive both, reference RNG stream as ground truth ----
+    # replicate over exactly ONE pmap device (flax's .replicate() would use
+    # all 8 virtual CPU devices; a 1-device pmap has the same semantics as
+    # this framework's single global program, so the curves are comparable
+    # without per-device mask bookkeeping)
+    ref_state = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x)[None, ...], ref_state
+    )
+    data_rng = np.random.RandomState(42)
+    mean = np.array([0.485, 0.456, 0.406])
+    std = np.array([0.229, 0.224, 0.225])
+    ref_losses, my_losses = [], []
+    for t in range(STEPS):
+        images_nchw = data_rng.randint(
+            0, 256, (BATCH, 3, IMAGE, IMAGE), dtype=np.uint8
+        )
+
+        # the noise key this step's pmap program is ABOUT to use (their
+        # split convention: used = split(rng)[0], /root/reference/src/
+        # pretraining.py:60-66), per device 0 of the replicated state
+        pre_noise = jax.device_get(ref_state.noise_rng)[0]
+        used_noise = jax.random.split(pre_noise)[0]
+        # replay the scope-path rng fold to extract the permutation (the
+        # permutation depends on the rng alone, not on params)
+        bound = ref_module.bind(ref_module_vars, rngs={"noise": used_noise})
+        normalized = (
+            np.moveaxis(images_nchw, 1, 3).astype(np.float32) / 255.0 - mean
+        ) / std
+        _, _, ref_restore = bound.model(
+            jnp.asarray(normalized, jnp.float32), det=False
+        )
+        injected = jnp.asarray(ref_restore, jnp.float32) / ref_restore.shape[0]
+
+        sharded = (jnp.asarray(images_nchw)[None],)  # 1 local device
+        ref_state, ref_metrics = ref.training_step(ref_state, sharded)
+        ref_losses.append(float(jax.device_get(ref_metrics["loss"])[0]))
+
+        my_params, my_opt_state, my_loss = my_step(
+            my_params, my_opt_state,
+            jnp.asarray(images_nchw.transpose(0, 2, 3, 1)), injected,
+        )
+        my_losses.append(float(my_loss))
+
+    ref_arr, my_arr = np.asarray(ref_losses), np.asarray(my_losses)
+    # both learn
+    assert ref_arr[-20:].mean() < ref_arr[:5].mean()
+    assert my_arr[-20:].mean() < my_arr[:5].mean()
+    # step-for-step: tight early, tolerance grows with compounding f32
+    # round-off divergence
+    np.testing.assert_allclose(my_arr[:20], ref_arr[:20], rtol=1e-4)
+    np.testing.assert_allclose(my_arr, ref_arr, rtol=1e-2)
+    # curve-level agreement stays tight to the end
+    np.testing.assert_allclose(
+        my_arr[-20:].mean(), ref_arr[-20:].mean(), rtol=1e-3
+    )
+
+
+# --------------------------------------------------------------------------
+# Pretrain → linear probe on the toy distribution, via the real CLI
+# --------------------------------------------------------------------------
+
+PT_STEPS, PR_STEPS = 600, 400
+
+
+def _overrides(tmp_path, shards, extra):
+    return [
+        f"data.train_shards={shards['train']}",
+        f"data.valid_shards={shards['val']}",
+        "data.image_size=32",
+        "data.crop_mode=none",
+        "data.hflip=0.0",
+        "data.workers=0",
+        f"data.valid_cache={tmp_path}/valcache",
+        "run.synthetic_data=false",
+        "run.use_wandb=false",
+        "run.sanity_eval=false",
+        "model.preset=vit_t16",
+    ] + extra
+
+
+def _probe(tmp_path, shards, name, pretrained=None):
+    from jumbo_mae_tpu_tpu.cli.train import train
+    from jumbo_mae_tpu_tpu.config import load_config
+
+    extra = [
+        f"run.output_dir={tmp_path}/{name}",
+        f"run.name={name}",
+        "run.mode=linear",
+        f"run.training_steps={PR_STEPS}",
+        "run.train_batch_size=64",
+        "run.valid_batch_size=64",
+        f"run.eval_interval={PR_STEPS}",
+        "run.log_interval=200",
+        # pooling=gap: texture identity lives in the patch tokens; probing
+        # the (zeros-init, briefly-pretrained) CLS tokens instead measures
+        # 0.11 vs GAP's 0.44 at identical pretraining (tuning runs) — and
+        # gap is the pooling mode the reference parsed but never wired
+        # (defect ledger #3), so this also exercises the fixed path
+        "model.overrides={image_size: 32, patch_size: 4, layers: 4, posemb: sincos2d, dtype: float32, labels: 10, pooling: gap}",
+        "model.criterion=ce",
+        "optim.name=sgd",
+        "optim.learning_rate=0.3",
+        "optim.lr_scaling=none",
+        "optim.momentum=0.9",
+        "optim.warmup_steps=0",
+        f"optim.training_steps={PR_STEPS}",
+    ]
+    if pretrained:
+        extra.append(f"run.pretrained_ckpt={pretrained}")
+    from pathlib import Path
+
+    recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    return train(load_config(recipe, _overrides(tmp_path, shards, extra)))
+
+
+def test_pretrain_then_linear_probe_beats_random_init(tmp_path):
+    """MAE pretraining through the full recipe machinery must produce
+    features a linear probe can use: probe(pretrained) ≫ probe(random
+    init) on the toy distribution."""
+    from pathlib import Path
+
+    from jumbo_mae_tpu_tpu.cli.train import train
+    from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.data.toy import write_toy_shards
+
+    shards = write_toy_shards(tmp_path / "shards", n_train=2048, n_val=512)
+
+    recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    pt_cfg = load_config(
+        recipe,
+        _overrides(
+            tmp_path,
+            shards,
+            [
+                f"run.output_dir={tmp_path}/pt",
+                "run.name=toy_pretrain",
+                "run.mode=pretrain",
+                f"run.training_steps={PT_STEPS}",
+                "run.train_batch_size=64",
+                "run.valid_batch_size=64",
+                f"run.eval_interval={PT_STEPS}",
+                "run.log_interval=200",
+                "model.overrides={image_size: 32, patch_size: 4, layers: 4, posemb: sincos2d, dtype: float32, mask_ratio: 0.75}",
+                "model.dec_layers=2",
+                "model.dec_dim=64",
+                "model.dec_heads=4",
+                "model.dec_dtype=float32",
+                "optim.learning_rate=1.5e-3",
+                "optim.lr_scaling=none",
+                "optim.warmup_steps=40",
+                f"optim.training_steps={PT_STEPS}",
+                "optim.b2=0.95",
+                "optim.weight_decay=0.05",
+            ],
+        ),
+    )
+    pt_metrics = train(pt_cfg)
+    assert np.isfinite(pt_metrics["val/loss"])
+
+    probed = _probe(
+        tmp_path, shards, "probe_pt",
+        pretrained=f"{tmp_path}/pt/toy_pretrain/ckpt",
+    )
+    control = _probe(tmp_path, shards, "probe_rand")
+
+    acc_pt = probed["val/acc1"]
+    acc_rand = control["val/acc1"]
+    print(f"[learning-e2e] probe acc1: pretrained={acc_pt:.3f} random={acc_rand:.3f}")
+    # the margin: well above chance (0.1) and well above the random-init
+    # probe — the claim is qualitative (representations ARE learned), the
+    # thresholds leave headroom over observed runs
+    assert acc_pt > acc_rand + 0.1, (acc_pt, acc_rand)
+    assert acc_pt > 1.5 * acc_rand, (acc_pt, acc_rand)
+    assert acc_pt > 0.25, acc_pt

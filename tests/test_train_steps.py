@@ -639,11 +639,25 @@ class TestOptim:
             pretrain_module(), tx, batch, mesh, mode="pretrain",
             init_seed=0, param_dtype="bfloat16",
         )
-        # the CLI's warm-start sequence (cli/train.py)
+        # the CLI's warm-start sequence (cli/train.py): merge in f32 so the
+        # master keeps the checkpoint's full precision, store the downcast
+        pretrained_f32 = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32) * (1.0 + 1e-4), pretrained
+        )  # perturb so values carry mantissa bits beyond bf16
         opt_state = jax.jit(
             state.tx.init, out_shardings=sharding.opt_state
-        )(pretrained)
+        )(pretrained_f32)
+        pretrained = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype), pretrained_f32, state.params
+        )
         state = state.replace(params=pretrained, opt_state=opt_state)
+        # the master must be the EXACT f32 checkpoint values, not a bf16
+        # round-trip of them
+        for m, v in zip(
+            jax.tree_util.tree_leaves(state.opt_state.inner_state.master),
+            jax.tree_util.tree_leaves(pretrained_f32),
+        ):
+            np.testing.assert_array_equal(np.asarray(m), np.asarray(v))
         for p, mw in zip(
             jax.tree_util.tree_leaves(state.params),
             jax.tree_util.tree_leaves(state.opt_state.inner_state.master),

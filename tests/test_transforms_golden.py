@@ -1,0 +1,273 @@
+"""Golden-array augmentation parity (VERDICT r3 item 3).
+
+timm/torchvision are not installed in this sandbox, so parity is pinned
+against what they are built FROM, plus committed golden fixtures:
+
+- the color ops (numpy/cv2 re-implementations in ``data/transforms.py``)
+  are compared against **PIL ImageEnhance directly** — the exact backend
+  timm and PIL-mode torchvision delegate to
+  (``/root/reference/src/dataset.py:41-53`` composes timm transforms over
+  PIL images);
+- crop/erase geometry is compared against **independent transcriptions of
+  the torchvision algorithms** (RandomResizedCrop.get_params,
+  RandomErasing.get_params) driven by the same rng stream — both sides
+  consume draws in torchvision's documented order, so any deviation in
+  sampling order, rounding, or bounds shows up as a pixel diff;
+- every RandAugment/AugMix op and color op is additionally pinned to
+  committed golden arrays (``tests/golden/transforms_golden.npz``) so a
+  PIL upgrade or a port edit that shifts pixel semantics fails loudly
+  rather than silently changing the training distribution.
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+
+import numpy as np
+import pytest
+from PIL import Image, ImageEnhance
+
+from jumbo_mae_tpu_tpu.data.transforms import (
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    random_erasing,
+    random_resized_crop,
+    resize,
+    simple_resize_crop,
+)
+
+GOLDEN = Path(__file__).parent / "golden" / "transforms_golden.npz"
+
+FACTORS = [0.1, 0.35, 0.7, 1.0, 1.31, 1.9]
+
+
+def _img(seed=0, size=(24, 32)):
+    return np.random.RandomState(seed).randint(
+        0, 256, (*size, 3), dtype=np.uint8
+    )
+
+
+# --------------------------------------------------------------------------
+# Color ops vs PIL ImageEnhance — the backend timm/torchvision-PIL wrap
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("factor", FACTORS)
+def test_brightness_matches_pil(factor):
+    img = _img(1)
+    ours = adjust_brightness(img, factor)
+    pil = np.asarray(ImageEnhance.Brightness(Image.fromarray(img)).enhance(factor))
+    assert np.abs(ours.astype(int) - pil.astype(int)).max() <= 1
+
+
+@pytest.mark.parametrize("factor", FACTORS)
+def test_contrast_matches_pil(factor):
+    img = _img(2)
+    ours = adjust_contrast(img, factor)
+    pil = np.asarray(ImageEnhance.Contrast(Image.fromarray(img)).enhance(factor))
+    assert np.abs(ours.astype(int) - pil.astype(int)).max() <= 2
+
+
+@pytest.mark.parametrize("factor", FACTORS)
+def test_saturation_matches_pil(factor):
+    img = _img(3)
+    ours = adjust_saturation(img, factor)
+    pil = np.asarray(ImageEnhance.Color(Image.fromarray(img)).enhance(factor))
+    assert np.abs(ours.astype(int) - pil.astype(int)).max() <= 2
+
+
+@pytest.mark.parametrize("delta", [-0.4, -0.1, 0.1, 0.25, 0.5])
+def test_hue_tracks_float_reference(delta):
+    """cv2's H is quantized to 180 steps (PIL-HSV uses 256) — exact parity
+    is impossible across backends, so pin against an exact float colorsys
+    rotation with a quantization-sized tolerance."""
+    import colorsys
+
+    pytest.importorskip("cv2")
+    img = _img(4, size=(12, 12))
+    ours = adjust_hue(img, delta).astype(float)
+    ref = np.empty_like(ours)
+    for y in range(img.shape[0]):
+        for x in range(img.shape[1]):
+            r, g, b = img[y, x] / 255.0
+            h, s, v = colorsys.rgb_to_hsv(r, g, b)
+            r2, g2, b2 = colorsys.hsv_to_rgb((h + delta) % 1.0, s, v)
+            ref[y, x] = np.array([r2, g2, b2]) * 255.0
+    # tolerance: one cv2 hue bin is 2 degrees; saturated pixels can move a
+    # few RGB units per bin
+    assert np.abs(ours - ref).mean() < 6.0
+    assert np.abs(ours - ref).max() < 40.0
+
+
+# --------------------------------------------------------------------------
+# Geometry vs independent transcriptions of the torchvision algorithms
+# --------------------------------------------------------------------------
+
+
+def _tv_rrc_params(rng, h, w, scale, ratio):
+    """Transcription of torchvision RandomResizedCrop.get_params: 10
+    attempts of (uniform area, log-uniform aspect), w from *aspect, h from
+    /aspect, top-left uniform; else aspect-clamped center fallback."""
+    area = h * w
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(*log_ratio))
+        cw = int(round(math.sqrt(target_area * aspect)))
+        ch = int(round(math.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            top = int(rng.integers(0, h - ch + 1))
+            left = int(rng.integers(0, w - cw + 1))
+            return top, left, ch, cw
+    in_ratio = w / h
+    if in_ratio < ratio[0]:
+        cw, ch = w, int(round(w / ratio[0]))
+    elif in_ratio > ratio[1]:
+        ch, cw = h, int(round(h * ratio[1]))
+    else:
+        cw, ch = w, h
+    return (h - ch) // 2, (w - cw) // 2, ch, cw
+
+
+@pytest.mark.parametrize(
+    "shape,scale",
+    [
+        ((64, 48), (0.2, 1.0)),
+        ((48, 64), (0.2, 1.0)),
+        ((100, 20), (0.9, 1.0)),  # extreme aspect → fallback path fires
+        ((20, 100), (0.9, 1.0)),
+        ((32, 32), (0.08, 1.0)),
+    ],
+)
+def test_random_resized_crop_geometry_matches_torchvision_algorithm(shape, scale):
+    """Run the port and the transcription from identical rng states over
+    many seeds; outputs must be pixel-identical (same draws, same rounding,
+    same fallback)."""
+    img = np.arange(shape[0] * shape[1] * 3, dtype=np.uint8).reshape(
+        (*shape, 3)
+    )  # position-coded pixels: geometry differences cannot cancel
+    for seed in range(50):
+        ours = random_resized_crop(
+            np.random.default_rng(seed), img, 16, scale=scale
+        )
+        top, left, ch, cw = _tv_rrc_params(
+            np.random.default_rng(seed), *shape, scale, (3 / 4, 4 / 3)
+        )
+        want = resize(img[top : top + ch, left : left + cw], (16, 16), "bicubic")
+        np.testing.assert_array_equal(ours, want, err_msg=f"seed {seed}")
+
+
+def _tv_erasing_params(rng, h, w, scale, ratio):
+    """Transcription of torchvision RandomErasing.get_params (h from
+    *aspect, w from /aspect, strict < bounds) with value='random'."""
+    area = h * w
+    log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+    for _ in range(10):
+        target = area * rng.uniform(scale[0], scale[1])
+        aspect = math.exp(rng.uniform(*log_ratio))
+        eh = int(round(math.sqrt(target * aspect)))
+        ew = int(round(math.sqrt(target / aspect)))
+        if 0 < eh < h and 0 < ew < w:
+            top = int(rng.integers(0, h - eh + 1))
+            left = int(rng.integers(0, w - ew + 1))
+            noise = rng.integers(0, 256, (eh, ew, 3), dtype=np.uint8)
+            return top, left, eh, ew, noise
+    return None
+
+
+def test_random_erasing_geometry_matches_torchvision_algorithm():
+    img = _img(7, size=(40, 40))
+    hits = 0
+    for seed in range(50):
+        ours = random_erasing(np.random.default_rng(seed), img, p=1.0)
+        rng = np.random.default_rng(seed)
+        assert rng.random() < 1.0  # the p-gate draw our port consumes first
+        params = _tv_erasing_params(rng, 40, 40, (0.02, 1 / 3), (0.3, 3.3))
+        if params is None:
+            np.testing.assert_array_equal(ours, img)
+            continue
+        top, left, eh, ew, noise = params
+        want = img.copy()
+        want[top : top + eh, left : left + ew] = noise
+        np.testing.assert_array_equal(ours, want, err_msg=f"seed {seed}")
+        hits += 1
+    assert hits > 40  # the geometry path, not the give-up path, was tested
+
+
+def test_simple_resize_crop_reflect_padding_semantics():
+    """SRC = Resize(short side) + reflect-pad 4 + RandomCrop — the reflect
+    border must equal torchvision's padding_mode='reflect' (edge-exclusive
+    mirror), pinned here via np.pad semantics on a position-coded image."""
+    img = np.arange(16 * 16 * 3, dtype=np.uint8).reshape(16, 16, 3)
+    out = simple_resize_crop(np.random.default_rng(0), img, 16)
+    assert out.shape == (16, 16, 3)
+    padded = np.pad(img, ((4, 4), (4, 4), (0, 0)), mode="reflect")
+    # edge-exclusive mirror: row -1 of the pad equals row 1 of the image
+    np.testing.assert_array_equal(padded[3, 4:-4], img[1])
+    np.testing.assert_array_equal(padded[-4, 4:-4], img[-2])
+    # the crop is a window of the padded plane
+    found = any(
+        np.array_equal(out, padded[t : t + 16, l : l + 16])
+        for t in range(9)
+        for l in range(9)
+    )
+    assert found
+
+
+# --------------------------------------------------------------------------
+# Committed golden fixtures: pin every op's exact pixels
+# --------------------------------------------------------------------------
+
+
+def golden_cases():
+    """(name, fn) pairs — deterministic op applications over a fixed image."""
+    from jumbo_mae_tpu_tpu.data import randaugment as ra
+
+    img = _img(11, size=(24, 24))
+    pil = Image.fromarray(img)
+    cases = {}
+    for name, fn in ra._OPS.items():
+        rng = np.random.default_rng(99)
+        args = ra._level_args(name, rng, 9.0, False)
+        cases[f"ra_{name}"] = np.asarray(fn(pil, *args))
+        rng = np.random.default_rng(100)
+        args = ra._level_args(name, rng, 5.0, True)
+        cases[f"ra_inc_{name}"] = np.asarray(fn(pil, *args))
+    for f in (0.35, 1.9):
+        cases[f"brightness_{f}"] = adjust_brightness(img, f)
+        cases[f"contrast_{f}"] = adjust_contrast(img, f)
+        cases[f"saturation_{f}"] = adjust_saturation(img, f)
+    cases["hue_0.25"] = adjust_hue(img, 0.25)
+    cases["rrc_seed3"] = random_resized_crop(
+        np.random.default_rng(3), img, 16
+    )
+    cases["erase_seed5"] = random_erasing(
+        np.random.default_rng(5), img, p=1.0
+    )
+    cases["randaugment_m9"] = ra.RandAugment(magnitude=9.0, mstd=0.5)(
+        np.random.default_rng(21), img
+    )
+    cases["augmix_m3"] = ra.AugMix(magnitude=3.0)(
+        np.random.default_rng(22), img
+    )
+    cases["autoaugment"] = ra.AutoAugment()(np.random.default_rng(23), img)
+    return cases
+
+
+def test_ops_match_committed_golden_arrays():
+    assert GOLDEN.exists(), (
+        f"{GOLDEN} missing — regenerate with "
+        "python tools/gen_transform_golden.py"
+    )
+    stored = np.load(GOLDEN)
+    cases = golden_cases()
+    assert sorted(stored.files) == sorted(cases), (
+        sorted(set(stored.files) ^ set(cases))
+    )
+    for name, arr in cases.items():
+        np.testing.assert_array_equal(
+            arr, stored[name], err_msg=f"golden drift in {name}"
+        )

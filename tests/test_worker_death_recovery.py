@@ -25,6 +25,10 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+# subprocess-heavy end-to-end suites: excluded from the <5-min signal
+# run (pytest -m "not slow")
+pytestmark = pytest.mark.slow
+
 REPO = Path(__file__).resolve().parent.parent
 
 

@@ -1,0 +1,33 @@
+"""Regenerate tests/golden/transforms_golden.npz.
+
+The fixture pins the exact pixel output of every augmentation op
+(tests/test_transforms_golden.py::golden_cases) so a PIL/cv2 upgrade or a
+port edit that shifts pixel semantics fails the suite instead of silently
+changing the training distribution. Run from the repo root:
+
+    python tools/gen_transform_golden.py
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+
+def main():
+    from test_transforms_golden import GOLDEN, golden_cases
+
+    GOLDEN.parent.mkdir(parents=True, exist_ok=True)
+    cases = golden_cases()
+    np.savez_compressed(GOLDEN, **cases)
+    print(f"wrote {GOLDEN} ({len(cases)} arrays)")
+
+
+if __name__ == "__main__":
+    main()
