@@ -117,6 +117,7 @@ class TestPallasKernel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow  # heavy compile; full suite covers it
 def test_lane_128_fallback_env_knob():
     """JUMBO_PALLAS_LANE=128 (the documented escape hatch for TPU
     generations where Mosaic rejects sub-128 minor dims) must produce the

@@ -202,6 +202,7 @@ def test_resize_posemb():
     np.testing.assert_allclose(resize_posemb(const, (1, 7, 7, 8)), 3.5, rtol=1e-6)
 
 
+@pytest.mark.slow  # heavy compile; full suite covers it
 def test_warm_start_resizes_real_pos_embed(tmp_path):
     """End-to-end: pretrain at 32px learnable posemb, warm-start a 48px
     model — pos_embed must be resized, not silently re-initialized."""

@@ -97,6 +97,7 @@ class TestJumboViT:
         vars_ = model.init({"params": jax.random.key(0)}, imgs)
         assert model.apply(vars_, imgs).shape == (2, 10)
 
+    @pytest.mark.slow  # heavy compile; full suite covers it
     def test_remat_matches_no_remat(self):
         imgs = jax.random.normal(jax.random.key(3), (2, 32, 32, 3))
         cfg = TINY.replace(labels=10)

@@ -74,6 +74,7 @@ def test_gpipe_composes_with_data_parallel(chain):
     )
 
 
+@pytest.mark.slow  # heavy compile; full suite covers it
 def test_gpipe_gradients_match_sequential(chain):
     """ppermute transposes to the reverse hop, so jax.grad through the
     schedule IS the backward pipeline — it must equal sequential grads."""
@@ -154,6 +155,7 @@ def test_gpipe_validates_microbatch_vs_data_axis(chain):
         gpipe(lambda p, h: h, stacked, x, mesh=mesh, microbatches=4)
 
 
+@pytest.mark.slow  # heavy compile; full suite covers it
 def test_gpipe_shared_params_jumbo_blocks(devices):
     """The signature JumboBlock chain — shared CLS MLP across every block —
     pipelines correctly: forward equals sequential, and the shared MLP's
@@ -251,6 +253,7 @@ def test_gpipe_composes_with_remat_blocks(chain):
 # --------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # heavy compile; full suite covers it
 def test_mesh_pipe_full_train_step_matches_sequential(devices):
     """The mesh.pipe=2 train step (GPipe encoder via the blocks_override
     seam) must track the ordinary sequential step: same init, same batch,

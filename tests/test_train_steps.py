@@ -162,6 +162,7 @@ class TestPretrainStep:
         ):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
+    @pytest.mark.slow  # heavy compile; full suite covers it
     def test_seq_parallel_ring_matches_single_device(self):
         # Sequence parallelism: same model weights, attn_impl="ring" on a
         # (data=2, seq=4) mesh vs einsum on one device. Identical RNG streams
@@ -192,6 +193,7 @@ class TestPretrainStep:
                     float(m_ring["loss"]), want, rtol=1e-4
                 )
 
+    @pytest.mark.slow  # heavy compile; full suite covers it
     def test_all_axes_composed_matches_single_device(self):
         # fsdp=2 × tensor=2 × seq=2 on one mesh, ring attention active —
         # every implemented parallelism at once must still equal the
@@ -549,6 +551,7 @@ class TestOptim:
                 ),
             )
 
+    @pytest.mark.slow  # heavy compile; full suite covers it
     def test_param_dtype_bf16_step_tracks_f32_run(self):
         """optim.param_dtype=bfloat16 end-to-end: params stored bf16, the
         f32 master lives in opt_state, loss trajectory tracks the f32 run."""
@@ -615,6 +618,7 @@ class TestOptim:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow  # heavy compile; full suite covers it
     def test_warm_start_resyncs_master_weights(self):
         """Swapping pretrained params into a param_dtype=bfloat16 state must
         re-init the optimizer state (the CLI does): otherwise the f32 master
