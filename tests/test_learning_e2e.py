@@ -265,6 +265,49 @@ def _probe(tmp_path, shards, name, pretrained=None):
     return train(load_config(recipe, _overrides(tmp_path, shards, extra)))
 
 
+def test_supervised_finetune_learns_toy_classes(tmp_path):
+    """Control for the probe experiment (and a supervised-path learning
+    proof of its own): full finetune from scratch must solve the toy task
+    well above both chance and the linear probes — it bounds what the
+    encoder architecture can extract from this distribution."""
+    from pathlib import Path
+
+    from jumbo_mae_tpu_tpu.cli.train import train
+    from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.data.toy import write_toy_shards
+
+    shards = write_toy_shards(tmp_path / "shards", n_train=2048, n_val=512)
+    recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    cfg = load_config(
+        recipe,
+        _overrides(
+            tmp_path,
+            shards,
+            [
+                f"run.output_dir={tmp_path}/ft",
+                "run.name=toy_ft",
+                "run.mode=finetune",
+                "run.training_steps=400",
+                "run.train_batch_size=64",
+                "run.valid_batch_size=64",
+                "run.eval_interval=400",
+                "run.log_interval=200",
+                "model.overrides={image_size: 32, patch_size: 4, layers: 4, posemb: sincos2d, dtype: float32, labels: 10}",
+                "model.criterion=ce",
+                "optim.name=adamw",
+                "optim.learning_rate=1e-3",
+                "optim.lr_scaling=none",
+                "optim.warmup_steps=20",
+                "optim.training_steps=400",
+            ],
+        ),
+    )
+    m = train(cfg)
+    # tuned runs reach 0.62; 0.45 leaves headroom while staying far above
+    # chance (0.1) and above the linear probes
+    assert m["val/acc1"] > 0.45, m["val/acc1"]
+
+
 def test_pretrain_then_linear_probe_beats_random_init(tmp_path):
     """MAE pretraining through the full recipe machinery must produce
     features a linear probe can use: probe(pretrained) ≫ probe(random
