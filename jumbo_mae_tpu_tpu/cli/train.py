@@ -17,7 +17,6 @@ Run:
 from __future__ import annotations
 
 import argparse
-import time
 from pathlib import Path
 
 import jax
@@ -31,7 +30,6 @@ from jumbo_mae_tpu_tpu.config import (
     load_config,
 )
 from jumbo_mae_tpu_tpu.data import (
-    DataConfig,
     TrainLoader,
     prefetch_to_device,
     split_for_accum,
