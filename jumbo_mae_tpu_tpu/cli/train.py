@@ -391,14 +391,28 @@ def train(cfg: TrainConfig) -> dict:
 
         n_dev = len(jax.devices())
         pipe_data = cfg.mesh.data
-        if pipe_data == -1:
+        if pipe_data in (1, -1):
+            # untouched default (or explicit fill): cover every device —
+            # and say so, because a gpipe microbatch-divisibility error
+            # downstream would otherwise reference a data axis the user
+            # never wrote (data=1 cannot opt out: a pipe mesh that strands
+            # devices is rejected below, so 1 could only ever mean
+            # n_dev == pipe, which the fill reproduces)
             pipe_data = max(1, n_dev // cfg.mesh.pipe)
+            if pipe_data > 1:
+                print(
+                    f"[mesh] data axis auto-filled to {pipe_data} "
+                    f"(pipe={cfg.mesh.pipe} over {n_dev} devices); set "
+                    "mesh.data explicitly to override"
+                )
         if pipe_data * cfg.mesh.pipe < n_dev:
-            print(
-                f"[mesh] WARNING: mesh data={pipe_data} x pipe="
-                f"{cfg.mesh.pipe} uses {pipe_data * cfg.mesh.pipe} of "
-                f"{n_dev} devices; set mesh.data=-1 (or explicitly) to "
-                "cover the rest"
+            # silently training on a subset is an easy way to waste a pod
+            raise ValueError(
+                f"mesh data={pipe_data} x pipe={cfg.mesh.pipe} covers only "
+                f"{pipe_data * cfg.mesh.pipe} of {n_dev} devices; choose "
+                "mesh.pipe to divide the device count (mesh.data=-1 "
+                "auto-fills the data axis), or expose fewer devices to "
+                "the process"
             )
         mesh = create_pipeline_mesh(data=pipe_data, pipe=cfg.mesh.pipe)
         pipe_microbatches = cfg.mesh.pipe_microbatches or cfg.mesh.pipe

@@ -98,6 +98,16 @@ class JumboViTConfig:
     # numerics, pick by profile (ops/masking.py validates the value)
     gather_impl: GatherImplT = "take"
 
+    def __post_init__(self):
+        if self.heads <= 0 or self.dim % self.heads:
+            # head_dim floors silently otherwise: heads=7 at dim=768 would
+            # train a 763-wide attention with no warning (bench.py's
+            # _parse_dec_heads already rejects this; the recipe/--set
+            # surface lands here)
+            raise ValueError(
+                f"dim ({self.dim}) must be divisible by heads ({self.heads})"
+            )
+
     @property
     def head_dim(self) -> int:
         return self.dim // self.heads
@@ -149,6 +159,13 @@ class DecoderConfig:
 
     dtype: str = "bfloat16"
     attn_impl: AttnImpl = "auto"
+
+    def __post_init__(self):
+        if self.heads <= 0 or self.dim % self.heads:
+            raise ValueError(
+                f"decoder dim ({self.dim}) must be divisible by heads "
+                f"({self.heads})"
+            )
 
     @property
     def head_dim(self) -> int:

@@ -31,6 +31,15 @@ from jumbo_mae_tpu_tpu.ops.posemb import sincos2d_positional_embedding
 
 TRUNC_NORMAL = init.truncated_normal(0.02)
 
+# attn_impl="auto" switches einsum → Pallas flash at this sequence length.
+# The v5e-measured crossover sits between 199 (einsum 1.7× faster) and 787
+# (flash 1.7× faster); 512 splits it conservatively. Env-overridable so a
+# different TPU generation can re-pin it from tools/flash_microbench.py
+# without a code change.
+import os as _os
+
+AUTO_FLASH_MIN_SEQ = int(_os.environ.get("JUMBO_AUTO_FLASH_MIN_SEQ", "512"))
+
 ConfigT = Any  # JumboViTConfig | DecoderConfig — same attribute surface
 
 
@@ -60,13 +69,6 @@ class Attention(nn.Module):
         # The flash/ring paths have no attention-probability dropout; any
         # dropout>0 must take the einsum path so training semantics don't
         # silently change.
-        #
-        # "auto" resolves to the einsum path: XLA's fused attention measured
-        # fastest at EVERY tested MAE shape on v5e — seq 199 (wash), 787
-        # (flash 37% slower), 3139 (flash 77% slower; einsum+remat still
-        # fits) — because the Pallas forward pairs with a slower blockwise
-        # backward (PERF.md §decisions). "flash" stays an explicit opt-in
-        # for memory regimes where the score tensor cannot exist at all.
         if cfg.attn_impl in ("flash", "ring") and cfg.dropout > 0.0 and not deterministic:
             # Both are explicit requests — "ring" for sequence parallelism,
             # "flash" for O(S) score memory; silently degrading either to
@@ -79,10 +81,25 @@ class Attention(nn.Module):
                 "dropout; set dropout=0.0 to train (droppath regularization "
                 "still applies)"
             )
+        impl = cfg.attn_impl
+        if impl == "auto":
+            # Measured crossover on v5e (tools/flash_microbench.py, round
+            # 5, fwd+bwd ms): einsum wins at MAE-224 shapes (seq 199: 5.2
+            # vs 8.7), the Pallas kernels win from long-context lengths up
+            # (seq 787: 9.0 vs 15.3; seq 3139: 24.7 vs 45.8) now that the
+            # kernels use bf16 MXU-rate operands and full-row blocks.
+            # dropout>0 training still needs einsum's materialized probs.
+            use_flash = (
+                jax.default_backend() == "tpu"
+                and x.shape[1] >= AUTO_FLASH_MIN_SEQ
+                and (cfg.dropout == 0.0 or deterministic)
+            )
+            impl = "flash" if use_flash else "einsum"
+
         # z_head_major tracks each branch's output layout: (B,H,S,D) for the
         # einsum path, (B,S,H,D) for flash/ring — set alongside z so a new
         # branch can't silently mismatch the out-projection's axes.
-        if cfg.attn_impl == "ring":
+        if impl == "ring":
             # Sequence parallelism: tokens shard over the ambient mesh's
             # "seq" axis, K/V ring-rotate over ICI (parallel/ring_attention).
             from jumbo_mae_tpu_tpu.parallel.ring_attention import (
@@ -90,7 +107,7 @@ class Attention(nn.Module):
             )
 
             z, z_head_major = ring_self_attention(q, k, v), False
-        elif cfg.attn_impl == "flash":
+        elif impl == "flash":
             from jumbo_mae_tpu_tpu.ops.flash_attention import flash_attention
 
             z, z_head_major = flash_attention(q, k, v), False

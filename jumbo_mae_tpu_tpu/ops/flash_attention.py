@@ -34,12 +34,14 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     *,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ) -> jax.Array:
     """Softmax(q·kᵀ)·v without materializing the score matrix.
 
     q, k, v: (batch, seq, heads, head_dim). Returns the same shape as q.
+    Block defaults follow ``pallas_flash_attention`` (big requests, clamped
+    per shape — see its docstring for the round-5 measurements).
     """
     seq_q, seq_k = q.shape[1], k.shape[1]
     if jax.default_backend() != "tpu":

@@ -51,6 +51,28 @@ NEG_INF = -1e30
 # just fatter HBM transients.
 LANE = int(os.environ.get("JUMBO_PALLAS_LANE", "8"))
 
+# Matmul operand dtype inside the kernels: the INPUT dtype (bf16 in
+# production) rather than an f32 upcast. bf16 operands feed the MXU at its
+# native rate — the prior unconditional f32 upcast cost multiple MXU passes
+# per dot, a plausible root cause of round 4's "flash loses to einsum
+# everywhere both fit". The einsum path materializes bf16 scores AND bf16
+# probs, so bf16 operands here are numerically comparable (scores still
+# accumulate f32 via preferred_element_type, softmax math stays f32, and
+# flash keeps its f32 online-softmax accumulation). f32 inputs (parity
+# oracles) are untouched. JUMBO_PALLAS_MM_F32=1 restores the f32 upcast.
+MM_F32 = os.environ.get("JUMBO_PALLAS_MM_F32") == "1"
+
+
+def _mm_dtype(ref) -> jnp.dtype:
+    return jnp.float32 if MM_F32 else ref.dtype
+
+# Block planning: by default the padded sequence rounds to the 128-lane tile
+# and the block shrinks to the largest divisor (at seq 787 → sk_pad 896 the
+# requested 256 collapses to 128, doubling streaming passes). With
+# JUMBO_PALLAS_PAD_TO_BLOCK=1 the sequence pads UP to a block multiple
+# instead (more masked rows, fewer/fatter passes) — measured per shape.
+PAD_TO_BLOCK = os.environ.get("JUMBO_PALLAS_PAD_TO_BLOCK") == "1"
+
 
 def _mask_cols(s, col0: int, valid_k: int):
     """Set score columns at global key index ≥ valid_k to −inf."""
@@ -60,17 +82,18 @@ def _mask_cols(s, col0: int, valid_k: int):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, valid_k: int):
-    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    mm = _mm_dtype(q_ref)
+    q = q_ref[0].astype(mm)  # (block_q, d)
     block_q, d = q.shape
     seq_k = k_ref.shape[1]
 
     def body(i, carry):
         m, l, acc = carry
-        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(mm)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(mm)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (block_q, block_k)
+        )  # (block_q, block_k), f32 accumulation
         if valid_k != seq_k:
             s = _mask_cols(s, i * block_k, valid_k)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
@@ -78,7 +101,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, valid
         alpha = jnp.exp(m - m_new)
         l = l * alpha + p.sum(axis=-1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(mm), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l, acc
 
@@ -96,26 +120,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int, valid
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *, block_k: int, valid_k: int
 ):
-    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
-    do = do_ref[0].astype(jnp.float32)
+    mm = _mm_dtype(q_ref)
+    q = q_ref[0].astype(mm)  # (block_q, d)
+    do = do_ref[0].astype(mm)
     lse = lse_ref[0][:, :1]  # (block_q, 1) — scalar replicated over lanes
     dd = dd_ref[0][:, :1]
     block_q, d = q.shape
     seq_k = k_ref.shape[1]
 
     def body(i, dq):
-        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(mm)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(mm)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if valid_k != seq_k:
             s = _mask_cols(s, i * block_k, valid_k)
-        p = jnp.exp(s - lse)  # (block_q, block_k)
+        p = jnp.exp(s - lse)  # (block_q, block_k), f32
         dp = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - dd)
+        ds = (p * (dp - dd)).astype(mm)
         return dq + jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -130,16 +155,17 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref, dv_ref,
     *, block_q: int, valid_k: int, masked: bool,
 ):
-    k = k_ref[0].astype(jnp.float32)  # (block_k, d)
-    v = v_ref[0].astype(jnp.float32)
+    mm = _mm_dtype(k_ref)
+    k = k_ref[0].astype(mm)  # (block_k, d)
+    v = v_ref[0].astype(mm)
     block_k, d = k.shape
     seq_q = q_ref.shape[1]
     col0 = pl.program_id(1) * block_k
 
     def body(i, carry):
         dk, dv = carry
-        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(mm)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(mm)
         lse = lse_ref[0, pl.ds(i * block_q, block_q), :1]
         dd = dd_ref[0, pl.ds(i * block_q, block_q), :1]
         s = jax.lax.dot_general(
@@ -149,12 +175,13 @@ def _bwd_dkv_kernel(
             s = _mask_cols(s, col0, valid_k)
         p = jnp.exp(s - lse)
         dv = dv + jax.lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(mm), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             dob, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - dd)
+        ds = (p * (dp - dd)).astype(mm)
         dk = dk + jax.lax.dot_general(
             ds, qb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -203,11 +230,18 @@ def _unfold(x, b, h, s, d):
 def _plan(q, k, block_q, block_k):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    # Pad ragged lengths only up to the 128-lane tile, then pick the largest
-    # block ≤ requested that divides the padded length — never pad to a full
-    # block multiple (at seq 787 that would waste ~30% of the rows).
-    sq_pad = _round_up(sq, 128)
-    sk_pad = _round_up(sk, 128)
+    # Default: pad ragged lengths only up to the 128-lane tile, then pick
+    # the largest block ≤ requested that divides the padded length (at seq
+    # 787 → 896 a requested 256 collapses to 128). PAD_TO_BLOCK instead
+    # pads up to a block multiple — more masked rows (787 → 1024, +14%),
+    # but fewer, fatter streaming passes; which wins is measured per shape
+    # (tools/flash_microbench.py).
+    if PAD_TO_BLOCK:
+        sq_pad = _round_up(sq, min(block_q, _round_up(sq, 128)))
+        sk_pad = _round_up(sk, min(block_k, _round_up(sk, 128)))
+    else:
+        sq_pad = _round_up(sq, 128)
+        sk_pad = _round_up(sk, 128)
     return (
         b, sq, h, d, sk, sq_pad, sk_pad,
         _largest_dividing_block(block_q, sq_pad),
@@ -315,8 +349,8 @@ def pallas_flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash attention over (batch, seq, heads, head_dim); q pre-scaled.
@@ -326,6 +360,13 @@ def pallas_flash_attention(
     first-class). Forward and backward are both Pallas kernels with O(seq)
     memory. ``interpret=True`` runs them in the Pallas interpreter (CPU
     tests).
+
+    Default blocks are 1024 (clamped per shape by ``_plan``): round-5
+    microbenches (tools/flash_microbench.py, v5e) showed the requested-256
+    default collapsing to 128 at seq 787 (896 tile-pad) and doubling the
+    streaming passes — big requests resolve to full-row or near-full-row
+    blocks (256@199, 896@787, 640@3139) and beat the einsum path at every
+    long-context shape (9.0 vs 15.3 ms at 787, 24.7 vs 45.8 at 3139).
     """
     out, _ = _flash_fwd(q, k, v, block_q, block_k, interpret, with_lse=False)
     return out

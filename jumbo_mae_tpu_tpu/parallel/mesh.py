@@ -54,6 +54,15 @@ class MeshConfig:
             )
 
     def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        if self.pipe > 1:
+            # A flat (data, fsdp, tensor, seq) mesh cannot express pipeline
+            # parallelism; silently dropping the knob would waste the pipe
+            # axis. Callers must route through create_pipeline_mesh (the
+            # CLI does: cli/train.py mesh.pipe branch).
+            raise ValueError(
+                "MeshConfig.pipe > 1 selects pipeline parallelism — build "
+                "the mesh with create_pipeline_mesh, not create_mesh/resolve"
+            )
         sizes = [self.data, self.fsdp, self.tensor, self.seq]
         if sizes.count(-1) > 1:
             raise ValueError("at most one mesh axis may be -1")

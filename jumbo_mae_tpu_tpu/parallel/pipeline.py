@@ -82,6 +82,7 @@ def gpipe(
     axis: str = "pipe",
     data_axis: str | None = "data",
     shared_params: dict | None = None,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
     """Run ``x`` through all stacked blocks under the GPipe schedule.
 
@@ -98,6 +99,15 @@ def gpipe(
     ``block_fn(one_block_params, h, shared_params)``, and its gradient
     comes back correctly summed over stages (the replicated-input
     transpose is a ``psum`` over ``pipe``).
+
+    ``rng`` (optional) enables stochastic blocks (dropout / droppath):
+    ``block_fn`` is then called with a trailing PRNG key derived per
+    (data-shard, global block index, microbatch) — every block application
+    anywhere in the schedule draws an independent stream, exactly the
+    independence structure the sequential path gets from flax folding the
+    "dropout" stream per module path (masks differ from sequential
+    execution, the distribution matches). Without it the schedule is
+    deterministic and ``block_fn`` keeps its short signature.
     """
     n_stages = mesh.shape[axis]
     n_blocks = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
@@ -122,6 +132,9 @@ def gpipe(
         )
 
     shared = {} if shared_params is None else shared_params
+    bps = n_blocks // n_stages  # blocks per stage
+    # a dummy key keeps the shard_map arity static when rng is unused
+    rng_in = rng if rng is not None else jax.random.key(0)
 
     @partial(
         jax.shard_map,
@@ -130,28 +143,43 @@ def gpipe(
             jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
             P(None, data_spec),
             jax.tree_util.tree_map(lambda _: P(), shared),  # replicated
+            P(),  # rng: replicated; decorrelated below by axis_index folds
         ),
         out_specs=P(None, data_spec),
         check_vma=False,
     )
-    def run(local_params, x_local, shared_local):
+    def run(local_params, x_local, shared_local, rng_local):
         stage = jax.lax.axis_index(axis)
         m = x_local.shape[0]
+        if rng is not None and data_spec:
+            # distinct dropout masks per data shard (the GSPMD sequential
+            # path gets this for free from sharding the global mask)
+            rng_local = jax.random.fold_in(
+                rng_local, jax.lax.axis_index(data_axis)
+            )
 
-        def apply_stage(h):
+        def apply_stage(h, mb_idx):
             # each stage applies its contiguous slice of blocks in order
-            def one(h, p):
-                if shared_params is None:
-                    return block_fn(p, h), None
-                return block_fn(p, h, shared_local), None
+            def one(h, xs):
+                p, local_idx = xs
+                args = (p, h) if shared_params is None else (p, h, shared_local)
+                if rng is None:
+                    return block_fn(*args), None
+                key = jax.random.fold_in(
+                    jax.random.fold_in(rng_local, stage * bps + local_idx),
+                    mb_idx,
+                )
+                return block_fn(*args, key), None
 
-            h, _ = jax.lax.scan(one, h, local_params)
+            h, _ = jax.lax.scan(one, h, (local_params, jnp.arange(bps)))
             return h
 
         def tick(carry, t):
             act, buf = carry
             inp = jnp.where(stage == 0, x_local[jnp.clip(t, 0, m - 1)], act)
-            out = apply_stage(inp)
+            # stage s processes microbatch t - s at tick t (clamped ticks
+            # compute garbage that is never collected)
+            out = apply_stage(inp, jnp.clip(t - stage, 0, m - 1))
             nxt = jax.lax.ppermute(
                 out, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)]
             )
@@ -170,7 +198,7 @@ def gpipe(
         mine = jnp.where(stage == n_stages - 1, buf, jnp.zeros_like(buf))
         return jax.lax.psum(mine, axis)
 
-    out = run(stacked_params, xm, shared)
+    out = run(stacked_params, xm, shared, rng_in)
     return out.reshape(batch, *x.shape[1:])
 
 
@@ -201,13 +229,29 @@ def make_jumbo_pipeline_apply(
     # profile of exactly the configs pipeline parallelism targets.
     block = maybe_remat(JumboBlock, cfg)(cfg, make_jumbo_mlp(cfg, name=None))
 
-    def apply(encoder_params: dict, x: jax.Array) -> jax.Array:
+    def apply(
+        encoder_params: dict, x: jax.Array, rng: jax.Array | None = None
+    ) -> jax.Array:
         stacked, _ = stack_block_params(encoder_params)
 
-        def block_fn(p, h, shared):
-            # a standalone JumboBlock scopes the shared MLP under itself; the
-            # encoder scopes it at the parent — graft it in per call
-            return block.apply({"params": {**p, "jumbo_mlp": shared}}, h, True)
+        if rng is None:
+
+            def block_fn(p, h, shared):
+                # a standalone JumboBlock scopes the shared MLP under
+                # itself; the encoder scopes it at the parent — graft it in
+                return block.apply(
+                    {"params": {**p, "jumbo_mlp": shared}}, h, True
+                )
+
+        else:
+
+            def block_fn(p, h, shared, key):
+                return block.apply(
+                    {"params": {**p, "jumbo_mlp": shared}},
+                    h,
+                    False,
+                    rngs={"dropout": key},
+                )
 
         return gpipe(
             block_fn,
@@ -216,6 +260,7 @@ def make_jumbo_pipeline_apply(
             mesh=mesh,
             microbatches=microbatches,
             shared_params=encoder_params["jumbo_mlp"],
+            rng=rng,
         )
 
     return apply

@@ -313,8 +313,13 @@ def _warn_on_dtype_casts(mgr, step, abstract):
                 + ") — numerics change mid-run; align the recipe's "
                 "mu/nu/param dtypes with the checkpoint if unintended"
             )
-    except Exception:
-        pass
+    except Exception as e:
+        # Never block a restore on the diagnostic — but don't degrade
+        # silently either: an Orbax metadata-layout change lands here.
+        print(
+            "[checkpoint] note: dtype-cast check unavailable "
+            f"({type(e).__name__}: {e})"
+        )
 
 
 # --------------------------------------------------------------------------

@@ -47,6 +47,11 @@ from jumbo_mae_tpu_tpu.train.state import (
 
 Mode = Literal["pretrain", "classify"]
 
+# Folded into the "dropout" stream before it enters the gpipe key
+# derivation ("pipe" in ASCII) — keeps pipeline keys out of any integer
+# range flax's path-folding could produce for the sequential blocks.
+PIPE_RNG_DOMAIN = 0x70697065
+
 
 def _tree_add(a, b):
     return jax.tree_util.tree_map(jnp.add, a, b)
@@ -139,13 +144,6 @@ def make_train_step(
             raise ValueError("pipe_microbatches requires encoder_cfg")
         if "pipe" not in mesh.shape:
             raise ValueError("pipe_microbatches requires a mesh with a 'pipe' axis")
-        if (encoder_cfg.dropout or 0) > 0 or (encoder_cfg.droppath or 0) > 0:
-            # gpipe applies blocks deterministically (no per-stage rng
-            # plumbing); droppath/dropout would silently become no-ops
-            raise ValueError(
-                "the pipeline-parallel path runs blocks deterministically; "
-                "set encoder dropout/droppath to 0"
-            )
         from jumbo_mae_tpu_tpu.parallel.pipeline import (
             make_jumbo_pipeline_apply,
         )
@@ -153,6 +151,12 @@ def make_train_step(
         pipeline_apply = make_jumbo_pipeline_apply(
             encoder_cfg, mesh=mesh, microbatches=pipe_microbatches
         )
+        # dropout/droppath ride gpipe's per-(shard, block, microbatch)
+        # key derivation (parallel/pipeline.py); deterministic configs
+        # skip the rng plumbing entirely
+        pipe_stochastic = (encoder_cfg.dropout or 0) > 0 or (
+            encoder_cfg.droppath or 0
+        ) > 0
 
     def loss_fn(params, batch_stats, micro_idx, batch, state):
         rngs = state.step_rngs(micro=micro_idx)
@@ -160,7 +164,16 @@ def make_train_step(
         extra = {}
         if pipe_microbatches:
             enc_params = params["encoder"]
-            extra["blocks_override"] = lambda x: pipeline_apply(enc_params, x)
+            # domain-separated from flax's own path-folded "dropout" use so
+            # the pipeline's integer folds can't collide with module streams
+            pipe_rng = (
+                jax.random.fold_in(rngs["dropout"], PIPE_RNG_DOMAIN)
+                if pipe_stochastic
+                else None
+            )
+            extra["blocks_override"] = lambda x: pipeline_apply(
+                enc_params, x, pipe_rng
+            )
         new_stats = None
         if batch_stats is not None:
             variables["batch_stats"] = batch_stats

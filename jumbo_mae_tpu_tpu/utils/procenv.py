@@ -18,6 +18,46 @@ from __future__ import annotations
 import os
 
 
+def host_fingerprint() -> str:
+    """Short stable hash of this host's CPU identity, for keying the
+    persistent XLA compile cache per machine.
+
+    XLA:CPU AOT cache entries embed the compiling machine's CPU features;
+    loading another machine's entries logs ``cpu_aot_loader.cc ... Machine
+    type used for XLA:CPU compilation doesn't match`` per program and slows
+    device-thread startup — which in round 4 pushed an 8-thread collective
+    rendezvous past its 40 s abort window on a 1-core host. Keying the
+    cache directory by this hash makes cross-machine reuse impossible.
+    (``__graft_entry__._host_fingerprint`` is a deliberate private copy —
+    that script must not import the package in the calling process.)
+    """
+    import hashlib
+    import platform
+
+    parts = [platform.machine()]
+    wanted = {"model name", "flags", "Features", "CPU implementer"}
+    seen: set[str] = set()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                key = line.split(":", 1)[0].strip()
+                if key in wanted and key not in seen:
+                    seen.add(key)
+                    parts.append(line.strip())
+                if seen == wanted:
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()[:12]
+
+
+def host_cache_dir(repo_root: str | os.PathLike) -> str:
+    """Host-keyed persistent-compile-cache path under ``repo_root``."""
+    return os.path.join(
+        str(repo_root), ".jax_cache", f"host-{host_fingerprint()}"
+    )
+
+
 def cpu_subprocess_env(
     n_devices: int | None = None,
     *,

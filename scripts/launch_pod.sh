@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Fan a training run out to every worker of a TPU pod slice.
+#
+# Role parity with the reference's worked multi-host workflow
+# (/root/reference/README.md:97-113): there, each recipe is a bash script
+# and the user hand-runs
+#   gcloud compute tpus tpu-vm ssh $TPU_NAME --worker=all \
+#     --command="... screen -dmL bash $CONFIG_FILE"
+# Here the recipe is a YAML + `--set` overrides and multi-host process
+# coordination is `cli.train --distributed` (jax.distributed.initialize);
+# this script owns the gcloud fan-out, detached launch, and log retrieval.
+#
+# Usage:
+#   scripts/launch_pod.sh launch recipes/pretrain_vit_l16.yaml \
+#       [--set run.name=l16-800ep ...]          # extra args pass through
+#   scripts/launch_pod.sh setup                 # bootstrap every worker
+#   scripts/launch_pod.sh status                # screen session per worker
+#   scripts/launch_pod.sh tail                  # last log lines per worker
+#   scripts/launch_pod.sh kill                  # stop the run everywhere
+#
+# Environment:
+#   TPU_NAME   (required) TPU VM / pod slice name
+#   TPU_ZONE   (default us-central2-b)
+#   TPU_PROJECT  optional gcloud project override
+#   REMOTE_DIR (default ~/jumbo_mae_tpu_tpu) repo checkout on the workers
+#   SESSION    (default mae) screen session name
+set -euo pipefail
+
+ZONE="${TPU_ZONE:-us-central2-b}"
+REMOTE_DIR="${REMOTE_DIR:-\$HOME/jumbo_mae_tpu_tpu}"
+SESSION="${SESSION:-mae}"
+
+usage() { sed -n '2,27p' "$0" | sed 's/^# \{0,1\}//'; exit 1; }
+
+[ $# -ge 1 ] || usage
+CMD="$1"; shift
+
+: "${TPU_NAME:?set TPU_NAME to the pod slice name}"
+
+GCLOUD=(gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone="$ZONE" --worker=all)
+if [ -n "${TPU_PROJECT:-}" ]; then
+  GCLOUD+=(--project="$TPU_PROJECT")
+fi
+
+run_everywhere() { "${GCLOUD[@]}" --command="$1"; }
+
+case "$CMD" in
+  setup)
+    run_everywhere "cd $REMOTE_DIR && bash scripts/setup.sh"
+    ;;
+  launch)
+    [ $# -ge 1 ] || { echo "launch needs a recipe path" >&2; exit 1; }
+    RECIPE="$1"; shift
+    # Remaining args (e.g. --set k=v) pass through to cli.train verbatim.
+    EXTRA=""
+    for a in "$@"; do EXTRA+=" $(printf '%q' "$a")"; done
+    # screen -dmL: detached + logged (screenlog.0 in $REMOTE_DIR), so the
+    # ssh fan-out returns immediately and `tail` can read progress — same
+    # detachment pattern as the reference's workflow.
+    run_everywhere "cd $REMOTE_DIR && screen -dmL -S $SESSION \
+python3 -m jumbo_mae_tpu_tpu.cli.train --config $(printf '%q' "$RECIPE") \
+--distributed$EXTRA"
+    echo "launched '$SESSION' on all workers of $TPU_NAME"
+    echo "follow with: $0 tail    stop with: $0 kill"
+    ;;
+  status)
+    run_everywhere "screen -ls || true"
+    ;;
+  tail)
+    run_everywhere "tail -n 20 $REMOTE_DIR/screenlog.0 2>/dev/null || echo '(no log yet)'"
+    ;;
+  kill)
+    run_everywhere "screen -S $SESSION -X quit || true"
+    ;;
+  *)
+    usage
+    ;;
+esac

@@ -20,10 +20,16 @@ import jax  # noqa: E402  (may already be imported by sitecustomize)
 jax.config.update("jax_platforms", "cpu")
 # Persistent compilation cache: the suite's cost is XLA compiles of tiny
 # train steps, which are identical run-to-run — cache them across processes.
+# Keyed per host (utils/procenv.py host_fingerprint): XLA:CPU AOT entries
+# from another machine deserialize through a slow mismatch path that round 4
+# showed can straggle collective rendezvous into its abort window.
 _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-jax.config.update(
-    "jax_compilation_cache_dir", os.path.join(_repo_root, ".jax_cache")
-)
+import sys  # noqa: E402
+
+sys.path.insert(0, _repo_root)
+from jumbo_mae_tpu_tpu.utils.procenv import host_cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", host_cache_dir(_repo_root))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 

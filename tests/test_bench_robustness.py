@@ -87,9 +87,9 @@ def test_bench_success_path_on_cpu():
     """The bench machinery end-to-end on the CPU backend (smoke model, no
     baseline leg): one valid JSON success line, rc 0. Keeps the success
     path from rotting between on-chip rounds."""
-    from jumbo_mae_tpu_tpu.utils.procenv import cpu_subprocess_env
+    from jumbo_mae_tpu_tpu.utils.procenv import cpu_subprocess_env, host_cache_dir
 
-    env = cpu_subprocess_env(1, compile_cache=REPO / ".jax_cache")
+    env = cpu_subprocess_env(1, compile_cache=host_cache_dir(REPO))
     env.update(
         {
             "BENCH_MODEL": "vit_t16",

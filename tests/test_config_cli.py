@@ -336,3 +336,17 @@ def test_sweep_ft_grid_matches_reference_loops():
         for wd in (0.06, 0.07, 0.08, 0.09)
         for lr in (1e-3, 3e-3)
     }
+
+
+def test_pipe_mesh_undercoverage_raises(tmp_path):
+    """mesh.pipe that strands devices must fail loudly, and the untouched
+    data default must auto-fill the data axis (advisor round-4 finding)."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    # 8 devices, pipe=3: auto-filled data=2 covers 6 of 8 -> raise
+    cfg = load_config(
+        RECIPES / "smoke_cpu.yaml",
+        [f"run.output_dir={tmp_path}", "mesh.pipe=3"],
+    )
+    with pytest.raises(ValueError, match="covers only"):
+        train(cfg)

@@ -82,3 +82,12 @@ def test_single_slice_devices_build_flat_without_warning(capsys):
     mesh = create_mesh(MeshConfig(data=2, fsdp=4), devices=devs)
     assert "WARNING" not in capsys.readouterr().out
     assert dict(mesh.shape) == {"data": 2, "fsdp": 4, "tensor": 1, "seq": 1}
+
+
+def test_resolve_rejects_pipe_gt_one():
+    """pipe>1 must route through create_pipeline_mesh; a flat mesh would
+    silently drop the knob (advisor round-4 finding)."""
+    with pytest.raises(ValueError, match="create_pipeline_mesh"):
+        MeshConfig(pipe=2).resolve(8)
+    with pytest.raises(ValueError, match="create_pipeline_mesh"):
+        create_mesh(MeshConfig(pipe=4), devices=[FakeDevice(i, 0, 8) for i in range(8)])

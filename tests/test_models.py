@@ -236,3 +236,19 @@ class TestMixupOps:
             np.testing.assert_allclose(
                 np.asarray(out_l.sum(-1)), np.ones(8), rtol=1e-5
             )
+
+
+def test_config_rejects_indivisible_heads():
+    """head_dim = dim // heads must not floor silently (advisor round-4):
+    the recipe surface (--set model.dec_heads=...) lands on these configs."""
+    from jumbo_mae_tpu_tpu.models.config import JumboViTConfig
+
+    with pytest.raises(ValueError, match="divisible"):
+        JumboViTConfig(dim=768, heads=7)
+    with pytest.raises(ValueError, match="divisible"):
+        DecoderConfig(dim=512, heads=7)
+    with pytest.raises(ValueError, match="divisible"):
+        DecoderConfig(dim=512, heads=16).replace(heads=3)
+    # valid ones still construct
+    assert JumboViTConfig(dim=768, heads=12).head_dim == 64
+    assert DecoderConfig(dim=512, heads=2).head_dim == 256

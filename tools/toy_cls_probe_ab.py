@@ -1,0 +1,156 @@
+"""Round-5 experiment driver: CLS-concat vs GAP linear probes on the toy
+distribution, across pretraining lengths and probe optimizers.
+
+The reference's reproduced ImageNet numbers flow through the CLS-concat
+probe (/root/reference/src/modeling.py:269-274 — three CLS tokens
+concatenated, BatchNorm, linear head), but round 4's toy learning proof
+certified only GAP pooling (CLS read ~chance after 600 pretrain steps).
+This script measures what it takes for the CLS probe to clear chance, so
+the slow test can assert it with evidence-backed thresholds.
+
+Usage: python tools/toy_cls_probe_ab.py [--steps 600,2400] [--out /tmp/ab]
+Writes one JSON line per (pt_steps, pooling, optimizer) cell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+
+def _base_overrides(tmp, shards):
+    return [
+        f"data.train_shards={shards['train']}",
+        f"data.valid_shards={shards['val']}",
+        "data.image_size=32",
+        "data.crop_mode=none",
+        "data.hflip=0.0",
+        "data.workers=0",
+        f"data.valid_cache={tmp}/valcache",
+        "run.synthetic_data=false",
+        "run.use_wandb=false",
+        "run.sanity_eval=false",
+        "model.preset=vit_t16",
+    ]
+
+
+def pretrain(tmp, shards, steps: int) -> str:
+    from jumbo_mae_tpu_tpu.cli.train import train
+    from jumbo_mae_tpu_tpu.config import load_config
+
+    recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    name = f"pt{steps}"
+    cfg = load_config(
+        recipe,
+        _base_overrides(tmp, shards)
+        + [
+            f"run.output_dir={tmp}/{name}",
+            f"run.name={name}",
+            "run.mode=pretrain",
+            f"run.training_steps={steps}",
+            "run.train_batch_size=64",
+            "run.valid_batch_size=64",
+            f"run.eval_interval={steps}",
+            "run.log_interval=200",
+            "model.overrides={image_size: 32, patch_size: 4, layers: 4, posemb: sincos2d, dtype: float32, mask_ratio: 0.75}",
+            "model.dec_layers=2",
+            "model.dec_dim=64",
+            "model.dec_heads=4",
+            "model.dec_dtype=float32",
+            "optim.learning_rate=1.5e-3",
+            "optim.lr_scaling=none",
+            "optim.warmup_steps=40",
+            f"optim.training_steps={steps}",
+            "optim.b2=0.95",
+            "optim.weight_decay=0.05",
+        ],
+    )
+    train(cfg)
+    return f"{tmp}/{name}/{name}/ckpt"
+
+
+def probe(
+    tmp,
+    shards,
+    name: str,
+    *,
+    pooling: str,
+    optimizer: str,
+    lr: float,
+    steps: int = 400,
+    pretrained: str | None = None,
+) -> float:
+    from jumbo_mae_tpu_tpu.cli.train import train
+    from jumbo_mae_tpu_tpu.config import load_config
+
+    recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    extra = [
+        f"run.output_dir={tmp}/{name}",
+        f"run.name={name}",
+        "run.mode=linear",
+        f"run.training_steps={steps}",
+        "run.train_batch_size=64",
+        "run.valid_batch_size=64",
+        f"run.eval_interval={steps}",
+        "run.log_interval=200",
+        "model.overrides={image_size: 32, patch_size: 4, layers: 4, "
+        "posemb: sincos2d, dtype: float32, labels: 10, pooling: "
+        + pooling
+        + "}",
+        "model.criterion=ce",
+        f"optim.name={optimizer}",
+        f"optim.learning_rate={lr}",
+        "optim.lr_scaling=none",
+        "optim.momentum=0.9",
+        "optim.warmup_steps=0",
+        f"optim.training_steps={steps}",
+    ]
+    if pretrained:
+        extra.append(f"run.pretrained_ckpt={pretrained}")
+    m = train(load_config(recipe, _base_overrides(tmp, shards) + extra))
+    return float(m["val/acc1"])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", default="600,2400")
+    ap.add_argument("--out", default="/tmp/toy_cls_ab")
+    ap.add_argument("--probes", default="cls:lars:0.3,cls:sgd:0.3,gap:sgd:0.3")
+    args = ap.parse_args()
+
+    from jumbo_mae_tpu_tpu.data.toy import write_toy_shards
+
+    tmp = Path(args.out)
+    tmp.mkdir(parents=True, exist_ok=True)
+    shards = write_toy_shards(tmp / "shards", n_train=2048, n_val=512)
+
+    results = []
+    for steps in [int(s) for s in args.steps.split(",")]:
+        ckpt = pretrain(tmp, shards, steps)
+        for spec in args.probes.split(","):
+            pooling, opt, lr = spec.split(":")
+            acc = probe(
+                tmp,
+                shards,
+                f"probe_{steps}_{pooling}_{opt}",
+                pooling=pooling,
+                optimizer=opt,
+                lr=float(lr),
+                pretrained=ckpt,
+            )
+            row = {
+                "pt_steps": steps,
+                "pooling": pooling,
+                "optimizer": opt,
+                "lr": float(lr),
+                "acc1": acc,
+            }
+            results.append(row)
+            print("RESULT", json.dumps(row), flush=True)
+
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()
