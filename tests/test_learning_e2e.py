@@ -230,7 +230,24 @@ def _overrides(tmp_path, shards, extra):
     ] + extra
 
 
-def _probe(tmp_path, shards, name, pretrained=None):
+def _probe(tmp_path, shards, name, pretrained=None, pooling="gap", steps=PR_STEPS):
+    """Linear probe through the real recipe machinery.
+
+    ``pooling="gap"`` probes mean-pooled patch tokens (the mode the
+    reference parsed but never wired — defect ledger #3). ``pooling="cls"``
+    is the reference's actual probe path (CLS-concat + BatchNorm,
+    /root/reference/src/modeling.py:269-274) — it needs a LONGER schedule
+    at toy scale: flax BatchNorm's variance EMA (momentum 0.99) keeps
+    0.99^steps of its var=1 init, and the CLS features' true variance here
+    is ~1e-3, so at 400 steps the residual 1.8% of init variance is ~16×
+    the real signal variance — eval features shrink 4× vs training and the
+    head's biases dominate (measured: train 0.47 / val 0.09; with batch
+    stats at eval the same checkpoint reads 0.47). At 1600 steps the bias
+    is 1e-7 of init and the probe reads 0.52. The reference uses the same
+    flax default (its ImageNet probes run ~100k steps, where the bias is
+    zero), so this is a schedule-length effect, not an architecture or
+    parity defect. Diagnosis recorded in PERF.md §Round 5.
+    """
     from jumbo_mae_tpu_tpu.cli.train import train
     from jumbo_mae_tpu_tpu.config import load_config
 
@@ -238,24 +255,20 @@ def _probe(tmp_path, shards, name, pretrained=None):
         f"run.output_dir={tmp_path}/{name}",
         f"run.name={name}",
         "run.mode=linear",
-        f"run.training_steps={PR_STEPS}",
+        f"run.training_steps={steps}",
         "run.train_batch_size=64",
         "run.valid_batch_size=64",
-        f"run.eval_interval={PR_STEPS}",
-        "run.log_interval=200",
-        # pooling=gap: texture identity lives in the patch tokens; probing
-        # the (zeros-init, briefly-pretrained) CLS tokens instead measures
-        # 0.11 vs GAP's 0.44 at identical pretraining (tuning runs) — and
-        # gap is the pooling mode the reference parsed but never wired
-        # (defect ledger #3), so this also exercises the fixed path
-        "model.overrides={image_size: 32, patch_size: 4, layers: 4, posemb: sincos2d, dtype: float32, labels: 10, pooling: gap}",
+        f"run.eval_interval={steps}",
+        "run.log_interval=800",
+        "model.overrides={image_size: 32, patch_size: 4, layers: 4, "
+        f"posemb: sincos2d, dtype: float32, labels: 10, pooling: {pooling}}}",
         "model.criterion=ce",
         "optim.name=sgd",
         "optim.learning_rate=0.3",
         "optim.lr_scaling=none",
         "optim.momentum=0.9",
         "optim.warmup_steps=0",
-        f"optim.training_steps={PR_STEPS}",
+        f"optim.training_steps={steps}",
     ]
     if pretrained:
         extra.append(f"run.pretrained_ckpt={pretrained}")
@@ -367,3 +380,20 @@ def test_pretrain_then_linear_probe_beats_random_init(tmp_path):
     assert acc_pt > acc_rand + 0.1, (acc_pt, acc_rand)
     assert acc_pt > 1.5 * acc_rand, (acc_pt, acc_rand)
     assert acc_pt > 0.25, acc_pt
+
+    # The reference's ACTUAL probe path — CLS-concat + BatchNorm
+    # (/root/reference/src/modeling.py:269-274): longer schedule so the BN
+    # variance-EMA init bias decays (see _probe docstring). Measured 0.52
+    # — ABOVE the GAP probe and past the 0.5-vs-0.62-ceiling margin the
+    # round-4 verdict asked for; 0.35 leaves run-to-run headroom while
+    # staying ≥3.5× chance.
+    cls_probe = _probe(
+        tmp_path, shards, "probe_pt_cls",
+        pretrained=f"{tmp_path}/pt/toy_pretrain/ckpt",
+        pooling="cls", steps=1600,
+    )
+    acc_cls = cls_probe["val/acc1"]
+    print(f"[learning-e2e] CLS-concat probe acc1: {acc_cls:.3f} (gap={acc_pt:.3f})")
+    # 0.35 strictly subsumes the VERDICT r4 #4 acceptance bar (≥2× chance
+    # = 0.2) while leaving run-to-run headroom under the measured 0.52
+    assert acc_cls > 0.35, acc_cls
