@@ -24,7 +24,7 @@ import io
 
 import numpy as np
 
-__all__ = ["toy_examples", "write_toy_shards"]
+__all__ = ["toy_examples", "toy_pretrain_hparams", "write_toy_shards"]
 
 
 def _class_bank(classes: int, waves: int, rng: np.random.Generator):
@@ -163,3 +163,46 @@ def write_toy_shards(
         "val": write_split("val", n_train, n_train + n_val),
         "classes": classes,
     }
+
+
+def toy_pretrain_hparams(
+    steps: int,
+    *,
+    dec_heads: int = 4,
+    seed: int = 0,
+    nu_dtype: str | None = None,
+) -> list[str]:
+    """CLI ``--set`` list for the canonical toy MAE pretrain — the
+    learning proof's operating point (600 steps, t16 @32px/4px patches,
+    2×64×4h decoder, lr 1.5e-3 / b2 0.95 / wd 0.05).
+
+    Single source of truth shared by ``tests/test_learning_e2e.py`` and
+    ``tools/toy_cls_probe_ab.py`` so the knob-A/B's baseline arm can
+    never silently drift from the configuration the learning proof
+    certifies. ``dec_heads`` / ``nu_dtype`` are the round-5
+    convergence-A/B knobs."""
+    out = [
+        "run.mode=pretrain",
+        f"run.seed={seed}",
+        f"run.init_seed={seed}",
+        f"run.training_steps={steps}",
+        "run.train_batch_size=64",
+        "run.valid_batch_size=64",
+        f"run.eval_interval={steps}",
+        "run.log_interval=200",
+        "model.overrides={image_size: 32, patch_size: 4, layers: 4, "
+        "posemb: sincos2d, dtype: float32, mask_ratio: 0.75}",
+        "model.dec_layers=2",
+        "model.dec_dim=64",
+        f"model.dec_heads={dec_heads}",
+        "model.dec_dtype=float32",
+        "optim.learning_rate=1.5e-3",
+        "optim.lr_scaling=none",
+        "optim.warmup_steps=40",
+        f"optim.training_steps={steps}",
+        "optim.b2=0.95",
+        "optim.weight_decay=0.05",
+    ]
+    if nu_dtype:
+        out.append(f"optim.nu_dtype={nu_dtype}")
+    return out

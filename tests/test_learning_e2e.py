@@ -334,6 +334,11 @@ def test_pretrain_then_linear_probe_beats_random_init(tmp_path):
     shards = write_toy_shards(tmp_path / "shards", n_train=2048, n_val=512)
 
     recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    from jumbo_mae_tpu_tpu.data.toy import toy_pretrain_hparams
+
+    # hyperparameters come from the shared single source of truth so the
+    # knob-A/B tool's baseline arm (tools/toy_cls_probe_ab.py) always
+    # measures exactly this configuration
     pt_cfg = load_config(
         recipe,
         _overrides(
@@ -342,24 +347,8 @@ def test_pretrain_then_linear_probe_beats_random_init(tmp_path):
             [
                 f"run.output_dir={tmp_path}/pt",
                 "run.name=toy_pretrain",
-                "run.mode=pretrain",
-                f"run.training_steps={PT_STEPS}",
-                "run.train_batch_size=64",
-                "run.valid_batch_size=64",
-                f"run.eval_interval={PT_STEPS}",
-                "run.log_interval=200",
-                "model.overrides={image_size: 32, patch_size: 4, layers: 4, posemb: sincos2d, dtype: float32, mask_ratio: 0.75}",
-                "model.dec_layers=2",
-                "model.dec_dim=64",
-                "model.dec_heads=4",
-                "model.dec_dtype=float32",
-                "optim.learning_rate=1.5e-3",
-                "optim.lr_scaling=none",
-                "optim.warmup_steps=40",
-                f"optim.training_steps={PT_STEPS}",
-                "optim.b2=0.95",
-                "optim.weight_decay=0.05",
-            ],
+            ]
+            + toy_pretrain_hparams(PT_STEPS),
         ),
     )
     pt_metrics = train(pt_cfg)

@@ -38,39 +38,38 @@ def _base_overrides(tmp, shards):
     ]
 
 
-def pretrain(tmp, shards, steps: int) -> str:
+def pretrain(
+    tmp,
+    shards,
+    steps: int,
+    *,
+    dec_heads: int = 4,
+    nu_dtype: str | None = None,
+    seed: int = 0,
+    name: str | None = None,
+) -> tuple[str, float]:
+    """MAE-pretrain on the toy shards; returns (ckpt path, final val loss).
+
+    ``dec_heads`` / ``nu_dtype`` are the round-5 convergence-A/B knobs
+    (VERDICT r4 #5): the production perf options under test are
+    ``model.dec_heads=2`` (53–54% MFU ladder) and
+    ``optim.nu_dtype=bfloat16``; the toy analog of the head ladder is
+    4 → 1 at dec dim 64 (minimum heads / maximum head_dim, same params
+    and FLOPs, like 16 → 2 at dim 512)."""
     from jumbo_mae_tpu_tpu.cli.train import train
     from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.data.toy import toy_pretrain_hparams
 
     recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
-    name = f"pt{steps}"
-    cfg = load_config(
-        recipe,
-        _base_overrides(tmp, shards)
-        + [
-            f"run.output_dir={tmp}/{name}",
-            f"run.name={name}",
-            "run.mode=pretrain",
-            f"run.training_steps={steps}",
-            "run.train_batch_size=64",
-            "run.valid_batch_size=64",
-            f"run.eval_interval={steps}",
-            "run.log_interval=200",
-            "model.overrides={image_size: 32, patch_size: 4, layers: 4, posemb: sincos2d, dtype: float32, mask_ratio: 0.75}",
-            "model.dec_layers=2",
-            "model.dec_dim=64",
-            "model.dec_heads=4",
-            "model.dec_dtype=float32",
-            "optim.learning_rate=1.5e-3",
-            "optim.lr_scaling=none",
-            "optim.warmup_steps=40",
-            f"optim.training_steps={steps}",
-            "optim.b2=0.95",
-            "optim.weight_decay=0.05",
-        ],
+    name = name or f"pt{steps}"
+    extra = [
+        f"run.output_dir={tmp}/{name}",
+        f"run.name={name}",
+    ] + toy_pretrain_hparams(
+        steps, dec_heads=dec_heads, seed=seed, nu_dtype=nu_dtype
     )
-    train(cfg)
-    return f"{tmp}/{name}/{name}/ckpt"
+    m = train(load_config(recipe, _base_overrides(tmp, shards) + extra))
+    return f"{tmp}/{name}/{name}/ckpt", float(m["val/loss"])
 
 
 def probe(
@@ -115,11 +114,42 @@ def probe(
     return float(m["val/acc1"])
 
 
+def knob_ab(tmp, shards, seeds: list[int]) -> list[dict]:
+    """Convergence A/B for the numerics-changing perf knobs (VERDICT r4
+    #5): matched-steps toy pretrain per arm, GAP probe at the established
+    400-step operating point, plus the pretrain val loss as a secondary
+    signal. Arms: baseline (heads=4, f32 nu), min-heads (heads=1 — the
+    toy analog of the production dec_heads=2 ladder), nu_dtype=bfloat16."""
+    arms = [
+        ("baseline_h4", dict(dec_heads=4)),
+        ("minheads_h1", dict(dec_heads=1)),
+        ("nu_bf16", dict(dec_heads=4, nu_dtype="bfloat16")),
+    ]
+    results = []
+    for arm, kw in arms:
+        for seed in seeds:
+            name = f"{arm}_s{seed}"
+            ckpt, val_loss = pretrain(
+                tmp, shards, 600, seed=seed, name=name, **kw
+            )
+            acc = probe(
+                tmp, shards, f"probe_{name}",
+                pooling="gap", optimizer="sgd", lr=0.3, pretrained=ckpt,
+            )
+            row = {"arm": arm, "seed": seed, "pt_val_loss": val_loss, "gap_probe_acc1": acc}
+            results.append(row)
+            print("RESULT", json.dumps(row), flush=True)
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", default="600,2400")
     ap.add_argument("--out", default="/tmp/toy_cls_ab")
     ap.add_argument("--probes", default="cls:lars:0.3,cls:sgd:0.3,gap:sgd:0.3")
+    ap.add_argument("--knob-ab", action="store_true",
+                    help="run the dec_heads / nu_dtype convergence A/B instead")
+    ap.add_argument("--seeds", default="0,1")
     args = ap.parse_args()
 
     from jumbo_mae_tpu_tpu.data.toy import write_toy_shards
@@ -128,9 +158,14 @@ def main():
     tmp.mkdir(parents=True, exist_ok=True)
     shards = write_toy_shards(tmp / "shards", n_train=2048, n_val=512)
 
+    if args.knob_ab:
+        results = knob_ab(tmp, shards, [int(s) for s in args.seeds.split(",")])
+        print(json.dumps(results, indent=2))
+        return
+
     results = []
     for steps in [int(s) for s in args.steps.split(",")]:
-        ckpt = pretrain(tmp, shards, steps)
+        ckpt, _ = pretrain(tmp, shards, steps)
         for spec in args.probes.split(","):
             pooling, opt, lr = spec.split(":")
             acc = probe(
