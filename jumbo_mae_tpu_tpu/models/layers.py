@@ -40,6 +40,34 @@ import os as _os
 
 AUTO_FLASH_MIN_SEQ = int(_os.environ.get("JUMBO_AUTO_FLASH_MIN_SEQ", "512"))
 
+
+def resolve_attn_impl(
+    impl: str,
+    *,
+    backend: str,
+    seq_len: int,
+    dropout: float,
+    deterministic: bool,
+) -> str:
+    """Resolve ``attn_impl="auto"`` to a concrete backend per call shape.
+
+    Measured crossover on v5e (tools/flash_microbench.py, round 5,
+    fwd+bwd ms): einsum wins at MAE-224 shapes (seq 199: 5.2 vs 8.7),
+    the Pallas kernels win from long-context lengths up (seq 787: 9.0 vs
+    15.3; seq 3139: 24.7 vs 45.8) now that they use bf16 MXU-rate
+    operands and full-row blocks. dropout>0 training still needs
+    einsum's materialized probs (flash has no probability dropout).
+    Explicit impl choices pass through untouched.
+    """
+    if impl != "auto":
+        return impl
+    use_flash = (
+        backend == "tpu"
+        and seq_len >= AUTO_FLASH_MIN_SEQ
+        and (dropout == 0.0 or deterministic)
+    )
+    return "flash" if use_flash else "einsum"
+
 ConfigT = Any  # JumboViTConfig | DecoderConfig — same attribute surface
 
 
@@ -49,6 +77,13 @@ class Attention(nn.Module):
     Parity: ``/root/reference/src/modeling.py:127-138`` — separate q/k/v
     projections to (heads, head_dim), queries pre-scaled by head_dim**-0.5,
     dropout on the attention probabilities and on the output projection.
+
+    The q/k/v projections stay ``nn.DenseGeneral`` deliberately: a
+    flat-2-D-matmul variant with identical params won a standalone
+    microbench (2.55 vs 2.8–3.8 ms at the H/14 encoder slice) but LOST
+    7% step-level on H/14 (269–270 vs 292 img/s, two runs) — in the full
+    graph XLA fuses the 4-D contraction's output layout straight into
+    the attention einsums, which the reshape breaks. PERF.md §Round 5.
     """
 
     cfg: ConfigT
@@ -81,20 +116,13 @@ class Attention(nn.Module):
                 "dropout; set dropout=0.0 to train (droppath regularization "
                 "still applies)"
             )
-        impl = cfg.attn_impl
-        if impl == "auto":
-            # Measured crossover on v5e (tools/flash_microbench.py, round
-            # 5, fwd+bwd ms): einsum wins at MAE-224 shapes (seq 199: 5.2
-            # vs 8.7), the Pallas kernels win from long-context lengths up
-            # (seq 787: 9.0 vs 15.3; seq 3139: 24.7 vs 45.8) now that the
-            # kernels use bf16 MXU-rate operands and full-row blocks.
-            # dropout>0 training still needs einsum's materialized probs.
-            use_flash = (
-                jax.default_backend() == "tpu"
-                and x.shape[1] >= AUTO_FLASH_MIN_SEQ
-                and (cfg.dropout == 0.0 or deterministic)
-            )
-            impl = "flash" if use_flash else "einsum"
+        impl = resolve_attn_impl(
+            cfg.attn_impl,
+            backend=jax.default_backend(),
+            seq_len=x.shape[1],
+            dropout=cfg.dropout,
+            deterministic=deterministic,
+        )
 
         # z_head_major tracks each branch's output layout: (B,H,S,D) for the
         # einsum path, (B,S,H,D) for flash/ring — set alongside z so a new

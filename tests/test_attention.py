@@ -161,3 +161,29 @@ print('LANE128-OK')
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "LANE128-OK" in proc.stdout
+
+
+def test_resolve_attn_impl_auto_policy():
+    """The auto policy (round 5): flash on TPU at long sequence unless
+    dropout is active in training; einsum otherwise; explicit impls pass
+    through untouched."""
+    from jumbo_mae_tpu_tpu.models.layers import (
+        AUTO_FLASH_MIN_SEQ,
+        resolve_attn_impl,
+    )
+
+    r = lambda **kw: resolve_attn_impl(
+        kw.pop("impl", "auto"),
+        backend=kw.pop("backend", "tpu"),
+        seq_len=kw.pop("seq_len", AUTO_FLASH_MIN_SEQ),
+        dropout=kw.pop("dropout", 0.0),
+        deterministic=kw.pop("deterministic", False),
+    )
+    assert r() == "flash"                                   # long seq, tpu
+    assert r(seq_len=AUTO_FLASH_MIN_SEQ - 1) == "einsum"    # short seq
+    assert r(backend="cpu") == "einsum"                     # not tpu
+    assert r(dropout=0.1) == "einsum"                       # train dropout
+    assert r(dropout=0.1, deterministic=True) == "flash"    # eval dropout ok
+    assert r(impl="einsum", seq_len=4096) == "einsum"       # explicit wins
+    assert r(impl="flash", seq_len=8) == "flash"
+    assert r(impl="ring", backend="cpu") == "ring"
