@@ -115,6 +115,7 @@ def make_train_iterator(
     per_process: int,
     start_step: int = 0,
     data_cursor: dict | None = None,
+    num_labels: int = 1000,
 ):
     """Build the device-prefetched train iterator.
 
@@ -152,7 +153,9 @@ def make_train_iterator(
         it = synthetic_batches(
             per_process,
             cfg.data.image_size,
-            labels=1000 if cfg.run.mode != "pretrain" else None,
+            # the MODEL's class count — labels >= cfg.labels one-hot to
+            # all-zero rows, silently zeroing CE loss and pinning acc at 1
+            labels=num_labels if cfg.run.mode != "pretrain" else None,
             grad_accum=cfg.run.grad_accum,
             seed=cfg.run.seed,
         )
@@ -193,14 +196,16 @@ def make_train_iterator(
     return prefetch_to_device(it, sharding), source, cursor_log
 
 
-def make_valid_iterator(cfg: TrainConfig, mesh, per_process: int):
+def make_valid_iterator(
+    cfg: TrainConfig, mesh, per_process: int, num_labels: int = 1000
+):
     sharding = batch_sharding(mesh, accum=False)
     if cfg.run.synthetic_data:
         def gen():
             it = synthetic_batches(
                 per_process,
                 cfg.data.image_size,
-                labels=1000 if cfg.run.mode != "pretrain" else None,
+                labels=num_labels if cfg.run.mode != "pretrain" else None,
                 seed=cfg.run.seed + 1,
             )
             for _, batch in zip(range(4), it):
@@ -385,8 +390,6 @@ def train(cfg: TrainConfig) -> dict:
     cfg.mesh.validate_pipe()
     pipe_microbatches = 0
     if cfg.mesh.pipe > 1:
-        if run.mode != "pretrain":
-            raise ValueError("mesh.pipe is wired for run.mode=pretrain only")
         from jumbo_mae_tpu_tpu.parallel import create_pipeline_mesh
 
         n_dev = len(jax.devices())
@@ -480,6 +483,16 @@ def train(cfg: TrainConfig) -> dict:
         print(f"[train] resumed from step {start_step}")
 
     mode_key = "pretrain" if run.mode == "pretrain" else "classify"
+    # mesh.pipe_decoder additionally depth-shards the MAE decoder stack
+    # (pretrain only; mesh.pipe must divide dec_layers)
+    dec_cfg = None
+    if cfg.mesh.pipe_decoder:
+        if run.mode != "pretrain" or not pipe_microbatches:
+            # never silently drop a parallelism knob
+            raise ValueError(
+                "mesh.pipe_decoder requires run.mode=pretrain and mesh.pipe>1"
+            )
+        dec_cfg = model.decoder_cfg
     train_step = make_train_step(
         mesh,
         state_sharding,
@@ -487,6 +500,7 @@ def train(cfg: TrainConfig) -> dict:
         grad_accum=run.grad_accum,
         pipe_microbatches=pipe_microbatches,
         encoder_cfg=enc_cfg if pipe_microbatches else None,
+        decoder_cfg=dec_cfg,
     )
     eval_step = make_eval_step(mesh, state_sharding, mode=mode_key)
 
@@ -508,7 +522,9 @@ def train(cfg: TrainConfig) -> dict:
         wandb_tags=tuple(run.wandb_tags),
         wandb_id=run.wandb_id,
     )
-    valid_factory = make_valid_iterator(cfg, mesh, per_process_valid)
+    valid_factory = make_valid_iterator(
+        cfg, mesh, per_process_valid, num_labels=enc_cfg.labels or 1000
+    )
     # all-padding eval batch, pre-sharded by EVERY process at setup so
     # exhausted hosts can keep stepping the collective eval program
     pad_batch = None
@@ -530,7 +546,8 @@ def train(cfg: TrainConfig) -> dict:
         )
 
     train_iter, source, cursor_log = make_train_iterator(
-        cfg, mesh, per_process, start_step, data_cursor
+        cfg, mesh, per_process, start_step, data_cursor,
+        num_labels=enc_cfg.labels or 1000,
     )
     meter = AverageMeter()
     timer = StepTimer(warmup_steps=min(2, max(1, run.training_steps - 1)))

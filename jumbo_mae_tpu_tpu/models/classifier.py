@@ -51,7 +51,12 @@ class ClassificationModel(nn.Module):
         )
 
     def __call__(
-        self, images: jax.Array, labels: jax.Array, deterministic: bool = True
+        self,
+        images: jax.Array,
+        labels: jax.Array,
+        deterministic: bool = True,
+        *,
+        blocks_override=None,
     ) -> dict[str, jax.Array]:
         cfg = self.encoder_cfg
         images = normalize_images(images, dtype=cfg.compute_dtype)
@@ -72,7 +77,9 @@ class ClassificationModel(nn.Module):
                     self.cutmix_alpha,
                 )
 
-        logits = self.model(images, deterministic).astype(jnp.float32)
+        logits = self.model(
+            images, deterministic, blocks_override=blocks_override
+        ).astype(jnp.float32)
         loss = CRITERIA[self.criterion](logits, labels)
 
         # Top-k accuracy as membership in the per-sample label set — exact for

@@ -47,7 +47,17 @@ class MAEDecoder(nn.Module):
     num_cls_tokens: int
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        deterministic: bool = True,
+        *,
+        blocks_override=None,
+    ) -> jax.Array:
+        """``blocks_override`` (optional callable ``tokens -> tokens``)
+        replaces the sequential block chain — the same pipeline-parallel
+        seam the encoder has (``JumboViT.__call__``), so the decoder stack
+        can be depth-sharded over a ``pipe`` mesh axis too."""
         cfg = self.cfg
         k = self.num_cls_tokens
         pos = sincos2d_positional_embedding(*self.grid, cfg.dim).reshape(
@@ -56,9 +66,12 @@ class MAEDecoder(nn.Module):
         x = jnp.concatenate(
             [x[:, :k, :], x[:, k:, :] + jnp.asarray(pos, x.dtype)], axis=1
         )
-        block_cls = maybe_remat(PlainBlock, cfg)
-        for i in range(cfg.layers):
-            x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
+        if blocks_override is not None:
+            x = blocks_override(x)
+        else:
+            block_cls = maybe_remat(PlainBlock, cfg)
+            for i in range(cfg.layers):
+                x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
         return nn.LayerNorm(dtype=cfg.compute_dtype, name="ln")(x)
 
 
@@ -108,6 +121,7 @@ class MAEPretrainModel(nn.Module):
         *,
         mask_noise: jax.Array | None = None,
         blocks_override=None,
+        dec_blocks_override=None,
     ):
         enc_cfg = self.encoder_cfg
         k = enc_cfg.num_cls_tokens
@@ -126,7 +140,9 @@ class MAEPretrainModel(nn.Module):
             visible, self.mask_token, ids_restore, impl=enc_cfg.gather_impl
         )
         decoded = self.decoder(
-            jnp.concatenate([cls, full], axis=1), deterministic
+            jnp.concatenate([cls, full], axis=1),
+            deterministic,
+            blocks_override=dec_blocks_override,
         )
         pred = self.pixel_proj(decoded[:, k:, :].astype(jnp.float32))
 

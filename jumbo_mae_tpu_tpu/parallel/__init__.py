@@ -1,6 +1,7 @@
 from jumbo_mae_tpu_tpu.parallel.mesh import MeshConfig, create_mesh
 from jumbo_mae_tpu_tpu.parallel.pipeline import (
     create_pipeline_mesh,
+    make_plain_pipeline_apply,
     gpipe,
     pipelined_blocks_apply,
     pipelined_jumbo_blocks_apply,
@@ -22,6 +23,7 @@ __all__ = [
     "MeshConfig",
     "create_mesh",
     "create_pipeline_mesh",
+    "make_plain_pipeline_apply",
     "gpipe",
     "pipelined_blocks_apply",
     "pipelined_jumbo_blocks_apply",

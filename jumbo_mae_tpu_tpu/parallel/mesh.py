@@ -43,6 +43,9 @@ class MeshConfig:
     seq: int = 1
     pipe: int = 1
     pipe_microbatches: int = 0  # 0 → defaults to the pipe size
+    # pretrain only: also depth-shard the MAE decoder stack over ``pipe``
+    # (the pipe size must divide dec_layers)
+    pipe_decoder: bool = False
 
     def validate_pipe(self) -> None:
         if self.pipe > 1 and any(

@@ -266,6 +266,46 @@ def make_jumbo_pipeline_apply(
     return apply
 
 
+def make_plain_pipeline_apply(
+    cfg, *, mesh: Mesh, microbatches: int
+) -> Callable[[dict, jax.Array], jax.Array]:
+    """Build ``apply(params, x) -> x`` that pipelines a plain pre-norm
+    block chain (``block_0…block_{L-1}`` of :class:`PlainBlock` — the MAE
+    decoder's stack) over the mesh's ``pipe`` axis.
+
+    Same factory pattern as :func:`make_jumbo_pipeline_apply` (module
+    constructed at factory time, honors ``cfg.grad_ckpt``); the optional
+    ``rng`` third argument enables dropout/droppath via gpipe's
+    per-(shard, block, microbatch) key derivation."""
+    from jumbo_mae_tpu_tpu.models.config import maybe_remat
+    from jumbo_mae_tpu_tpu.models.layers import PlainBlock
+
+    block = maybe_remat(PlainBlock, cfg)(cfg)
+
+    def apply(
+        params: dict, x: jax.Array, rng: jax.Array | None = None
+    ) -> jax.Array:
+        stacked, _ = stack_block_params(params)
+
+        if rng is None:
+
+            def block_fn(p, h):
+                return block.apply({"params": p}, h, True)
+
+        else:
+
+            def block_fn(p, h, key):
+                return block.apply(
+                    {"params": p}, h, False, rngs={"dropout": key}
+                )
+
+        return gpipe(
+            block_fn, stacked, x, mesh=mesh, microbatches=microbatches, rng=rng
+        )
+
+    return apply
+
+
 def pipelined_jumbo_blocks_apply(
     cfg,
     encoder_params: dict,

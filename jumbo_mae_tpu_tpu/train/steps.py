@@ -125,6 +125,7 @@ def make_train_step(
     grad_accum: int = 1,
     pipe_microbatches: int = 0,
     encoder_cfg: Any = None,
+    decoder_cfg: Any = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step.
 
@@ -132,48 +133,74 @@ def make_train_step(
     ``grad_accum > 1``: batch leaves are (accum, micro, ...) and a
     ``lax.scan`` accumulates gradients before the single optimizer update.
 
-    ``pipe_microbatches > 0`` (pretrain only, requires ``encoder_cfg`` and a
-    mesh with a ``pipe`` axis): the encoder's block chain runs through the
-    GPipe schedule (``parallel/pipeline.py``) via the model's
-    ``blocks_override`` seam — same parameters, pipelined execution.
+    ``pipe_microbatches > 0`` (requires ``encoder_cfg`` and a mesh with a
+    ``pipe`` axis): the encoder's block chain runs through the GPipe
+    schedule (``parallel/pipeline.py``) via the model's ``blocks_override``
+    seam — same parameters, pipelined execution. Works for BOTH modes
+    (pretrain and classify/finetune — the classifier shares the JumboViT
+    encoder). With ``decoder_cfg`` additionally set (pretrain only), the
+    MAE decoder stack is depth-sharded through the same schedule via its
+    own seam (``dec_blocks_override``).
     """
     if pipe_microbatches:
-        if mode != "pretrain":
-            raise ValueError("pipeline parallelism is wired for pretrain only")
         if encoder_cfg is None:
             raise ValueError("pipe_microbatches requires encoder_cfg")
         if "pipe" not in mesh.shape:
             raise ValueError("pipe_microbatches requires a mesh with a 'pipe' axis")
+        if decoder_cfg is not None and mode != "pretrain":
+            raise ValueError("decoder pipelining applies to pretrain only")
         from jumbo_mae_tpu_tpu.parallel.pipeline import (
             make_jumbo_pipeline_apply,
+            make_plain_pipeline_apply,
         )
 
         pipeline_apply = make_jumbo_pipeline_apply(
             encoder_cfg, mesh=mesh, microbatches=pipe_microbatches
         )
+        # the encoder subtree lives under "encoder" in MAEPretrainModel
+        # trees and "model" in ClassificationModel trees
+        enc_key = "encoder" if mode == "pretrain" else "model"
         # dropout/droppath ride gpipe's per-(shard, block, microbatch)
         # key derivation (parallel/pipeline.py); deterministic configs
         # skip the rng plumbing entirely
         pipe_stochastic = (encoder_cfg.dropout or 0) > 0 or (
             encoder_cfg.droppath or 0
         ) > 0
+        dec_pipeline_apply = None
+        if decoder_cfg is not None:
+            dec_pipeline_apply = make_plain_pipeline_apply(
+                decoder_cfg, mesh=mesh, microbatches=pipe_microbatches
+            )
+            dec_stochastic = (decoder_cfg.dropout or 0) > 0 or (
+                decoder_cfg.droppath or 0
+            ) > 0
 
     def loss_fn(params, batch_stats, micro_idx, batch, state):
         rngs = state.step_rngs(micro=micro_idx)
         variables = {"params": params}
         extra = {}
         if pipe_microbatches:
-            enc_params = params["encoder"]
+            enc_params = params[enc_key]
             # domain-separated from flax's own path-folded "dropout" use so
-            # the pipeline's integer folds can't collide with module streams
+            # the pipeline's integer folds can't collide with module
+            # streams; encoder and decoder pipelines get disjoint folds
+            pipe_base = jax.random.fold_in(rngs["dropout"], PIPE_RNG_DOMAIN)
             pipe_rng = (
-                jax.random.fold_in(rngs["dropout"], PIPE_RNG_DOMAIN)
-                if pipe_stochastic
-                else None
+                jax.random.fold_in(pipe_base, 0) if pipe_stochastic else None
             )
             extra["blocks_override"] = lambda x: pipeline_apply(
                 enc_params, x, pipe_rng
             )
+            if dec_pipeline_apply is not None:
+                dec_params = params["decoder"]
+                dec_rng = (
+                    jax.random.fold_in(pipe_base, 1)
+                    if dec_stochastic
+                    else None
+                )
+                extra["dec_blocks_override"] = lambda x: dec_pipeline_apply(
+                    dec_params, x, dec_rng
+                )
         new_stats = None
         if batch_stats is not None:
             variables["batch_stats"] = batch_stats

@@ -350,3 +350,33 @@ def test_pipe_mesh_undercoverage_raises(tmp_path):
     )
     with pytest.raises(ValueError, match="covers only"):
         train(cfg)
+
+
+def test_synthetic_iterators_respect_model_label_count(devices):
+    """Synthetic batches must draw labels from the MODEL's class count:
+    out-of-range labels one-hot to all-zero rows, silently zeroing the CE
+    loss and pinning accuracy at 1.0 (round-5 fix)."""
+    import jax
+
+    from jumbo_mae_tpu_tpu.cli.train import (
+        make_train_iterator,
+        make_valid_iterator,
+    )
+    from jumbo_mae_tpu_tpu.parallel import MeshConfig, create_mesh
+
+    cfg = load_config(
+        RECIPES / "smoke_cpu.yaml",
+        [
+            "run.mode=finetune",
+            "model.overrides={mask_ratio: null, image_size: 32, patch_size: 4, labels: 10}",
+        ],
+    )
+    mesh = create_mesh(MeshConfig(data=1, fsdp=1))
+    it, _, _ = make_train_iterator(cfg, mesh, 8, num_labels=10)
+    batch = next(it)
+    labels = jax.device_get(batch["labels"])
+    assert labels.max() < 10 and labels.min() >= 0, labels
+
+    vit = make_valid_iterator(cfg, mesh, 8, num_labels=10)()
+    vlabels = jax.device_get(next(vit)["labels"])
+    assert vlabels.max() < 10 and vlabels.min() >= 0, vlabels

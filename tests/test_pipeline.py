@@ -434,3 +434,126 @@ def test_mesh_pipe_train_step_with_droppath(devices):
         losses.append(float(m["loss"]))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_mesh_pipe_classify_train_step_matches_sequential(devices):
+    """Round 5: pipeline parallelism covers the classify/finetune mode too
+    (the classifier shares the JumboViT encoder; blocks_override threads
+    through ClassificationModel). Pipelined step ≡ sequential step."""
+    from jumbo_mae_tpu_tpu.models import ClassificationModel, preset
+    from jumbo_mae_tpu_tpu.parallel import MeshConfig, create_mesh
+    from jumbo_mae_tpu_tpu.train import (
+        OptimConfig,
+        create_sharded_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    enc = preset(
+        "vit_t16", image_size=32, patch_size=8, mask_ratio=None, labels=10,
+        dtype="float32", layers=4,
+    )
+    rs = np.random.RandomState(0)
+    batch = {
+        "images": jnp.asarray(rs.randint(0, 256, (8, 32, 32, 3)), jnp.uint8),
+        "labels": jnp.asarray(rs.randint(0, 10, (8,)), jnp.int32),
+    }
+    opt = OptimConfig(
+        learning_rate=1e-3, lr_scaling="none", warmup_steps=1, training_steps=10
+    )
+
+    def run(pipe):
+        module = ClassificationModel(enc)
+        tx = make_optimizer(opt, 256)
+        mesh = (
+            create_pipeline_mesh(data=1, pipe=2)
+            if pipe
+            else create_mesh(MeshConfig(data=1, fsdp=1))
+        )
+        state, sharding = create_sharded_state(
+            module, tx, batch, mesh, mode="classify", init_seed=0, rng_seed=0
+        )
+        step = make_train_step(
+            mesh, sharding, mode="classify",
+            pipe_microbatches=2 if pipe else 0,
+            encoder_cfg=enc if pipe else None,
+        )
+        losses = []
+        for _ in range(4):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    seq, piped = run(False), run(True)
+    np.testing.assert_allclose(piped, seq, rtol=2e-4)
+    assert piped[-1] < piped[0]
+
+
+def test_mesh_pipe_decoder_stack_matches_sequential(devices):
+    """Round 5: the MAE decoder stack is pipelinable too (its own
+    blocks_override seam + make_plain_pipeline_apply). Encoder AND decoder
+    pipelined ≡ fully sequential."""
+    from jumbo_mae_tpu_tpu.models import DecoderConfig, MAEPretrainModel, preset
+    from jumbo_mae_tpu_tpu.parallel import MeshConfig, create_mesh
+    from jumbo_mae_tpu_tpu.train import (
+        OptimConfig,
+        create_sharded_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    enc = preset(
+        "vit_t16", image_size=32, patch_size=8, mask_ratio=0.75, labels=None,
+        dtype="float32", layers=4,
+    )
+    dec = DecoderConfig(layers=2, dim=32, heads=2, dtype="float32")
+    batch = {
+        "images": jnp.asarray(
+            np.random.RandomState(0).randint(0, 256, (8, 32, 32, 3)), jnp.uint8
+        )
+    }
+    opt = OptimConfig(
+        learning_rate=1e-3, lr_scaling="none", warmup_steps=1, training_steps=10
+    )
+
+    def run(pipe):
+        module = MAEPretrainModel(enc, dec)
+        tx = make_optimizer(opt, 256)
+        mesh = (
+            create_pipeline_mesh(data=1, pipe=2)
+            if pipe
+            else create_mesh(MeshConfig(data=1, fsdp=1))
+        )
+        state, sharding = create_sharded_state(
+            module, tx, batch, mesh, mode="pretrain", init_seed=0, rng_seed=0
+        )
+        step = make_train_step(
+            mesh, sharding, mode="pretrain",
+            pipe_microbatches=2 if pipe else 0,
+            encoder_cfg=enc if pipe else None,
+            decoder_cfg=dec if pipe else None,
+        )
+        losses = []
+        for _ in range(4):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    seq, piped = run(False), run(True)
+    np.testing.assert_allclose(piped, seq, rtol=2e-4)
+    assert piped[-1] < piped[0]
+
+
+def test_decoder_pipelining_guards():
+    from jumbo_mae_tpu_tpu.models import DecoderConfig, preset
+    from jumbo_mae_tpu_tpu.train import make_train_step
+
+    enc = preset("vit_t16", image_size=32, patch_size=8, mask_ratio=None,
+                 labels=10, dtype="float32", layers=4)
+    mesh = create_pipeline_mesh(data=1, pipe=2)
+    with pytest.raises(ValueError, match="pretrain only"):
+        make_train_step(
+            mesh, None, mode="classify", pipe_microbatches=2,
+            encoder_cfg=enc,
+            decoder_cfg=DecoderConfig(layers=2, dim=32, heads=2),
+        )
