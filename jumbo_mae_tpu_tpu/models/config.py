@@ -93,6 +93,10 @@ class JumboViTConfig:
     # TPU-first knobs
     dtype: str = "bfloat16"  # compute dtype; params always float32
     attn_impl: AttnImpl = "auto"
+    # attn_impl="ring" only: per-hop lowering — "einsum" (O((S/n)²) local
+    # scores) or "flash" (Pallas kernels + differentiable lse merge,
+    # O(S/n) score memory; falls back to einsum off-TPU)
+    ring_inner: str = "einsum"
     # masking shuffle/unshuffle lowering: "take" (XLA dynamic gather) or
     # "onehot" (0/1 MXU matmul, concat-free unshuffle) — bit-identical
     # numerics, pick by profile (ops/masking.py validates the value)
@@ -159,6 +163,7 @@ class DecoderConfig:
 
     dtype: str = "bfloat16"
     attn_impl: AttnImpl = "auto"
+    ring_inner: str = "einsum"
 
     def __post_init__(self):
         if self.heads <= 0 or self.dim % self.heads:

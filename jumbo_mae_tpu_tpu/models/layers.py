@@ -134,7 +134,10 @@ class Attention(nn.Module):
                 ring_self_attention,
             )
 
-            z, z_head_major = ring_self_attention(q, k, v), False
+            z, z_head_major = (
+                ring_self_attention(q, k, v, inner=cfg.ring_inner),
+                False,
+            )
         elif impl == "flash":
             from jumbo_mae_tpu_tpu.ops.flash_attention import flash_attention
 

@@ -283,7 +283,7 @@ def _flash_fwd(q, k, v, block_q, block_k, interpret, with_lse: bool):
     return (out, lse[..., 0]) if with_lse else (out, None)
 
 
-def _flash_bwd(q, k, v, o, lse, g, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, o, lse, g, block_q, block_k, interpret, g_lse=None):
     b, sq, h, d, sk, sq_pad, sk_pad, block_q, block_k = _plan(q, k, block_q, block_k)
     qf = _fold(_pad_seq(q, sq_pad), b, h, sq_pad, d)
     kf = _fold(_pad_seq(k, sk_pad), b, h, sk_pad, d)
@@ -295,6 +295,14 @@ def _flash_bwd(q, k, v, o, lse, g, block_q, block_k, interpret):
     # Both per-row scalars are replicated over the lane dim only here, at
     # kernel entry (the lse residual is stored compact, (b*h, sq_pad)).
     dd = (dof.astype(jnp.float32) * of.astype(jnp.float32)).sum(-1)
+    if g_lse is not None:
+        # lse cotangent (pallas_flash_attention_with_lse): ∂lse/∂s_j = p_j,
+        # so it folds into the score cotangent as ds = p·(dp − (D − g_lse))
+        # — shift D per row, kernels unchanged. Pad rows get 0 (no-op).
+        dd = dd - jnp.pad(
+            g_lse.astype(jnp.float32),
+            ((0, 0), (0, sq_pad - g_lse.shape[1])),
+        )
     dd = jnp.broadcast_to(dd[..., None], (b * h, sq_pad, LANE))
     lse = jnp.broadcast_to(lse[..., None], (b * h, sq_pad, LANE))
 
@@ -383,3 +391,43 @@ def _vjp_bwd(block_q, block_k, interpret, residuals, g):
 
 
 pallas_flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def pallas_flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Like :func:`pallas_flash_attention` but also returns the per-row
+    logsumexp ``lse`` with shape (batch·heads, seq_q) — DIFFERENTIABLE in
+    both outputs, which block-merging callers (ring attention's flash
+    inner) need: the merge weights are functions of lse, so its cotangent
+    must reach q and k.
+
+    The lse cotangent costs nothing extra in the backward: with
+    ``p = exp(s − lse)``, ``∂lse/∂s_j = p_j``, so the score cotangent
+    becomes ``ds = p·(dp − (D − g_lse))`` — the existing kernels run
+    unchanged with ``D`` shifted by ``−g_lse`` per row.
+    """
+    out, lse = _flash_fwd(q, k, v, block_q, block_k, interpret, with_lse=True)
+    return out, lse[:, : q.shape[1]]
+
+
+def _vjp_lse_fwd(q, k, v, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, block_q, block_k, interpret, with_lse=True)
+    return (out, lse[:, : q.shape[1]]), (q, k, v, out, lse)
+
+
+def _vjp_lse_bwd(block_q, block_k, interpret, residuals, gs):
+    q, k, v, o, lse = residuals
+    g, g_lse = gs
+    return _flash_bwd(
+        q, k, v, o, lse, g, block_q, block_k, interpret, g_lse=g_lse
+    )
+
+
+pallas_flash_attention_with_lse.defvjp(_vjp_lse_fwd, _vjp_lse_bwd)

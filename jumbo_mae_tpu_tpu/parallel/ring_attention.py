@@ -34,6 +34,8 @@ def ring_attention(
     kv_mask: jax.Array | None = None,
     *,
     axis_name: str,
+    inner: str = "einsum",
+    interpret: bool = False,
 ) -> jax.Array:
     """Online-softmax attention with K/V ring rotation over ``axis_name``.
 
@@ -43,7 +45,30 @@ def ring_attention(
     block, so padded tokens (uneven sequence splits) never receive weight.
     Must run inside ``shard_map``/``pmap`` with ``axis_name`` bound. Returns
     the local query block's exact global attention output.
+
+    ``inner="flash"`` computes each hop's local block with the Pallas
+    flash kernels (``ops/pallas/attention.py``) and merges hops in
+    log-sum-exp space — per-device score memory drops from
+    O((S/n)²) to O(S/n), the right memory class for exactly the
+    long-context regime ring attention targets (and the kernels are
+    faster than einsum at those chunk lengths — PERF.md §Decisions 1).
+    Requires ``kv_mask=None`` (even splits): the kernels mask trailing
+    pad only, not arbitrary key masks.
     """
+    if inner == "flash":
+        if kv_mask is not None:
+            raise ValueError(
+                "inner='flash' supports even sequence splits only "
+                "(kv_mask must be None — pad-free sharding)"
+            )
+        if jax.default_backend() == "tpu" or interpret:
+            return _ring_attention_flash(
+                q, k, v, axis_name=axis_name, interpret=interpret
+            )
+        # off-TPU there are no Mosaic kernels; silently running the Pallas
+        # INTERPRETER would be orders of magnitude slower than the einsum
+        # inner — fall back like ops/flash_attention.py does
+        # (``interpret=True`` keeps the kernel path for CPU tests).
     n = jax.lax.psum(1, axis_name)
     bq, sq, h, d = q.shape
 
@@ -94,6 +119,60 @@ def ring_attention(
     return (acc / l.transpose(0, 2, 1, 3)).astype(q.dtype)
 
 
+def _ring_attention_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash-kernel hop body for :func:`ring_attention` (``inner="flash"``).
+
+    Each visiting K/V block is attended with the O(chunk)-memory Pallas
+    kernels via :func:`pallas_flash_attention_with_lse` — DIFFERENTIABLE
+    in both outputs, so autodiff through the merge below produces the lse
+    cotangents the weights depend on (a stopped-lse merge would silently
+    drop the softmax-denominator gradient path). Hops combine in lse
+    space: with ``out_h`` softmax-normalized over its block and
+    ``exp(lse_h) = Σ_j exp(s_j)``, the running ``(out, lse)`` pair merges
+    as a two-way log-sum-exp — numerically stable and exact. Per-device
+    score memory is O(local_seq), the memory class ring attention exists
+    for; the kernels are also faster than einsum at long chunk lengths
+    (PERF.md §Decisions 1).
+    """
+    from jumbo_mae_tpu_tpu.ops.pallas.attention import (
+        pallas_flash_attention_with_lse,
+    )
+
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    bq, sq, h, d = q.shape
+
+    def hop(carry, _):
+        out, lse, k_cur, v_cur = carry
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        out_h, lse_h = pallas_flash_attention_with_lse(
+            q, k_cur, v_cur, 1024, 1024, interpret
+        )
+        lse_h = lse_h.reshape(bq, h, sq).transpose(0, 2, 1)[..., None]
+        m_new = jnp.maximum(lse, lse_h)  # (b, sq, h, 1)
+        w_prev = jnp.exp(lse - m_new)
+        w_h = jnp.exp(lse_h - m_new)
+        denom = w_prev + w_h
+        out = out * (w_prev / denom) + out_h.astype(jnp.float32) * (
+            w_h / denom
+        )
+        lse = m_new + jnp.log(denom)
+        return (out, lse, k_nxt, v_nxt), None
+
+    out0 = jnp.zeros((bq, sq, h, d), jnp.float32)
+    lse0 = jnp.full((bq, sq, h, 1), NEG_INF, jnp.float32)
+    (out, _, _, _), _ = jax.lax.scan(hop, (out0, lse0, k, v), None, length=n)
+    return out.astype(q.dtype)
+
+
 def ring_attention_sharded(
     q: jax.Array,
     k: jax.Array,
@@ -102,12 +181,15 @@ def ring_attention_sharded(
     *,
     seq_axis: str = "seq",
     batch_axes=("data", "fsdp"),
+    inner: str = "einsum",
+    interpret: bool = False,
 ) -> jax.Array:
     """Explicit-mesh alias of :func:`ring_self_attention`: global
     (B, S, H, D) inputs with S sharded over ``seq_axis`` (and batch over
     ``batch_axes``); emits the identically sharded attention output."""
     return ring_self_attention(
-        q, k, v, seq_axis=seq_axis, batch_axes=batch_axes, mesh=mesh
+        q, k, v, seq_axis=seq_axis, batch_axes=batch_axes, mesh=mesh,
+        inner=inner, interpret=interpret,
     )
 
 
@@ -119,6 +201,8 @@ def ring_self_attention(
     seq_axis: str = "seq",
     batch_axes=("data", "fsdp"),
     mesh: Mesh | None = None,
+    inner: str = "einsum",
+    interpret: bool = False,
 ) -> jax.Array:
     """Sequence-parallel self-attention, for use inside model code under
     ``jit``. Uses the *ambient* mesh by default (activate with
@@ -144,12 +228,23 @@ def ring_self_attention(
     qkv_spec = P(bspec, seq_axis, None, None)
     if not pad:
         return jax.shard_map(
-            partial(ring_attention, axis_name=seq_axis),
+            partial(
+                ring_attention,
+                axis_name=seq_axis,
+                inner=inner,
+                interpret=interpret,
+            ),
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec),
             out_specs=qkv_spec,
             check_vma=False,
         )(q, k, v)
+    if inner == "flash":
+        raise ValueError(
+            "inner='flash' requires the sequence length to divide the "
+            f"'{seq_axis}' axis ({s} over {n} shards needs padding, and "
+            "the flash kernels mask trailing pad only)"
+        )
     widths = ((0, 0), (0, pad), (0, 0), (0, 0))
     q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
     kv_mask = jnp.broadcast_to(jnp.arange(s_pad) < s, (b, s_pad))
