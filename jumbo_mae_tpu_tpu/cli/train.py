@@ -70,7 +70,13 @@ def build_model(cfg: TrainConfig):
     if mode == "pretrain":
         enc = preset(m.preset, labels=None, **{"mask_ratio": 0.75, **m.overrides})
         dec = DecoderConfig(
-            layers=m.dec_layers, dim=m.dec_dim, heads=m.dec_heads, dtype=m.dec_dtype
+            **{
+                "layers": m.dec_layers,
+                "dim": m.dec_dim,
+                "heads": m.dec_heads,
+                "dtype": m.dec_dtype,
+                **m.dec_overrides,
+            }
         )
         model = MAEPretrainModel(enc, dec, norm_pix_loss=m.norm_pix_loss)
         flops = pretrain_flops_per_image(enc, dec)

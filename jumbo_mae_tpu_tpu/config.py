@@ -37,11 +37,17 @@ class ModelConfig:
 
     preset: str = "vit_b16"
     overrides: dict[str, Any] = field(default_factory=dict)
-    # decoder (pretrain only)
+    # decoder (pretrain only). The common knobs are first-class fields; every
+    # other DecoderConfig field (dropout/droppath/layerscale/grad_ckpt/
+    # remat_policy/attn_impl/ring_inner) is reachable via ``dec_overrides``,
+    # mirroring the encoder's ``overrides`` (parity: the reference's
+    # --dec-dropout/--dec-droppath/--dec-layerscale flags,
+    # /root/reference/src/main_pretrain.py).
     dec_layers: int = 8
     dec_dim: int = 512
     dec_heads: int = 16
     dec_dtype: str = "bfloat16"
+    dec_overrides: dict[str, Any] = field(default_factory=dict)
     norm_pix_loss: bool = True
     # classifier head (finetune/linear only)
     mixup: float = 0.0

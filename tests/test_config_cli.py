@@ -83,6 +83,37 @@ def test_repeated_set_flags_accumulate():
     assert cfg.run.name == "xyz"
 
 
+def test_dec_overrides_reach_decoder_config():
+    """Recipe-surface parity with the reference's --dec-dropout /
+    --dec-droppath / --dec-layerscale flags: every DecoderConfig field is
+    reachable via model.dec_overrides dotted keys."""
+    from jumbo_mae_tpu_tpu.cli.train import build_model
+
+    doc = apply_overrides(
+        {},
+        [
+            "model.dec_overrides.droppath=0.1",
+            "model.dec_overrides.dropout=0.05",
+            "model.dec_overrides.layerscale=true",
+            "model.preset=vit_t16",
+        ],
+    )
+    cfg = config_from_dict(doc)
+    model, _, _ = build_model(cfg)
+    assert model.decoder_cfg.droppath == 0.1
+    assert model.decoder_cfg.dropout == 0.05
+    assert model.decoder_cfg.layerscale is True
+    # first-class fields still win unless overridden
+    assert model.decoder_cfg.layers == cfg.model.dec_layers
+
+    with pytest.raises(TypeError):
+        build_model(
+            config_from_dict(
+                apply_overrides({}, ["model.dec_overrides.bogus=1"])
+            )
+        )
+
+
 def test_unknown_key_rejected():
     with pytest.raises(ValueError, match="unknown"):
         config_from_dict({"run": {"bogus_key": 1}})
