@@ -393,6 +393,13 @@ def train(cfg: TrainConfig) -> dict:
     per_process = run.train_batch_size // process_count
     per_process_valid = max(1, run.valid_batch_size // process_count)
 
+    if run.eval_only and not (cfg.data.valid_shards or run.synthetic_data):
+        # fail before any device/state work
+        raise ValueError(
+            "run.eval_only requires validation data "
+            "(data.valid_shards or run.synthetic_data)"
+        )
+
     cfg.mesh.validate_pipe()
     pipe_microbatches = 0
     if cfg.mesh.pipe > 1:
@@ -427,10 +434,42 @@ def train(cfg: TrainConfig) -> dict:
         pipe_microbatches = cfg.mesh.pipe_microbatches or cfg.mesh.pipe
     else:
         mesh = create_mesh(cfg.mesh)
+    if cfg.mesh.pipe_decoder and (run.mode != "pretrain" or not pipe_microbatches):
+        # never silently drop a parallelism knob
+        raise ValueError(
+            "mesh.pipe_decoder requires run.mode=pretrain and mesh.pipe>1"
+        )
     model, enc_cfg, flops_per_image = build_model(cfg)
-    tx = make_optimizer(
-        cfg.optim, run.train_batch_size, num_layers=enc_cfg.layers
+
+    # after config/mesh validation (so invalid runs never create checkpoint
+    # directories) but before the expensive sharded-state build, so an
+    # unsatisfiable eval_only restore fails fast. A non-resume eval_only run
+    # never saves — skip the Checkpointer (and its eager dir creation).
+    ckpt = (
+        None
+        if run.eval_only and not run.resume
+        else Checkpointer(cfg.checkpoint_config())
     )
+    resuming = run.resume and ckpt is not None and ckpt.latest_step() is not None
+    if run.eval_only and run.resume and not resuming:
+        # an explicit restore request that can't be satisfied must not fall
+        # through to plausible-looking random-init metrics
+        ckpt.close()
+        raise FileNotFoundError(
+            "run.eval_only with run.resume=true but no checkpoint "
+            f"under {cfg.checkpoint_config().directory}"
+        )
+
+    if run.eval_only:
+        # evaluation never steps the optimizer — a no-op tx keeps AdamW's
+        # ~2x-params moment buffers off the device entirely
+        import optax
+
+        tx = optax.identity()
+    else:
+        tx = make_optimizer(
+            cfg.optim, run.train_batch_size, num_layers=enc_cfg.layers
+        )
 
     example = _example_batch(cfg, per_process)
     state, state_sharding = create_sharded_state(
@@ -444,8 +483,6 @@ def train(cfg: TrainConfig) -> dict:
         param_dtype=cfg.optim.param_dtype,
     )
 
-    ckpt = Checkpointer(cfg.checkpoint_config())
-    resuming = run.resume and ckpt.latest_step() is not None
     if run.pretrained_ckpt and not resuming:
         # (skipped on resume: the checkpoint restore below overwrites params
         # AND opt_state anyway — re-doing the merge + a full jitted tx.init
@@ -483,7 +520,12 @@ def train(cfg: TrainConfig) -> dict:
     start_step = 0
     data_cursor = None
     if resuming:
-        state, extra = ckpt.restore(state, sharding=state_sharding)
+        if run.eval_only:
+            # params/batch_stats/rng only — the saved opt_state never
+            # touches the device (tx is a no-op identity here)
+            state, extra = ckpt.restore_eval(state, sharding=state_sharding)
+        else:
+            state, extra = ckpt.restore(state, sharding=state_sharding)
         start_step = int(state.step)
         data_cursor = extra.get("data_cursor")
         print(f"[train] resumed from step {start_step}")
@@ -491,22 +533,19 @@ def train(cfg: TrainConfig) -> dict:
     mode_key = "pretrain" if run.mode == "pretrain" else "classify"
     # mesh.pipe_decoder additionally depth-shards the MAE decoder stack
     # (pretrain only; mesh.pipe must divide dec_layers)
-    dec_cfg = None
-    if cfg.mesh.pipe_decoder:
-        if run.mode != "pretrain" or not pipe_microbatches:
-            # never silently drop a parallelism knob
-            raise ValueError(
-                "mesh.pipe_decoder requires run.mode=pretrain and mesh.pipe>1"
-            )
-        dec_cfg = model.decoder_cfg
-    train_step = make_train_step(
-        mesh,
-        state_sharding,
-        mode=mode_key,
-        grad_accum=run.grad_accum,
-        pipe_microbatches=pipe_microbatches,
-        encoder_cfg=enc_cfg if pipe_microbatches else None,
-        decoder_cfg=dec_cfg,
+    dec_cfg = model.decoder_cfg if cfg.mesh.pipe_decoder else None
+    train_step = (
+        None
+        if run.eval_only  # dead work in an eval-and-exit run
+        else make_train_step(
+            mesh,
+            state_sharding,
+            mode=mode_key,
+            grad_accum=run.grad_accum,
+            pipe_microbatches=pipe_microbatches,
+            encoder_cfg=enc_cfg if pipe_microbatches else None,
+            decoder_cfg=dec_cfg,
+        )
     )
     eval_step = make_eval_step(mesh, state_sharding, mode=mode_key)
 
@@ -516,7 +555,10 @@ def train(cfg: TrainConfig) -> dict:
         # pre-flight print, /root/reference/src/pretraining.py:214)
         print(param_summary(state.params))
     preempt = PreemptionGuard()
-    preempt.install()
+    if not run.eval_only:
+        # eval_only has no step loop to honor the flag and nothing to
+        # checkpoint — default signal behavior (exit now) is the honest one
+        preempt.install()
     logger = MetricLogger(
         Path(run.output_dir) / run.name,
         name=run.name,
@@ -544,6 +586,22 @@ def train(cfg: TrainConfig) -> dict:
         pad_batch = next(
             prefetch_to_device(iter([host_pad]), batch_sharding(mesh, accum=False))
         )
+
+    if run.eval_only:
+        assert valid_factory is not None  # guaranteed by the top-of-train check
+        if is_main and not (resuming or run.pretrained_ckpt):
+            print(
+                "[eval] WARNING: eval_only on a fresh random init — set "
+                "run.pretrained_ckpt or run.resume=true to restore weights"
+            )
+        val = evaluate(eval_step, state, valid_factory(), pad_batch)
+        logger.log(val, step=start_step)
+        if is_main:
+            print(f"[eval] step {start_step}: {val}")
+        if ckpt is not None:
+            ckpt.close()
+        logger.close()
+        return val
 
     if run.sanity_eval and valid_factory is not None:
         print(

@@ -74,6 +74,10 @@ class RunConfig:
 
     synthetic_data: bool = False
     sanity_eval: bool = True
+    # evaluate-and-exit: restore weights (run.pretrained_ckpt or run.resume)
+    # and run one full validation pass — no training. Beyond the reference
+    # (its eval only ever runs inline in the train loop).
+    eval_only: bool = False
     resume: bool = False
     pretrained_ckpt: str = ""
     profile_dir: str = ""

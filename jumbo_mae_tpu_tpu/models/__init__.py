@@ -4,7 +4,7 @@ from jumbo_mae_tpu_tpu.models.config import (
     PRESETS,
     preset,
 )
-from jumbo_mae_tpu_tpu.models.vit import JumboViT
+from jumbo_mae_tpu_tpu.models.vit import JumboViT, pool_tokens
 from jumbo_mae_tpu_tpu.models.mae import MAEDecoder, MAEPretrainModel
 from jumbo_mae_tpu_tpu.models.classifier import ClassificationModel
 
@@ -17,4 +17,5 @@ __all__ = [
     "MAEDecoder",
     "MAEPretrainModel",
     "ClassificationModel",
+    "pool_tokens",
 ]

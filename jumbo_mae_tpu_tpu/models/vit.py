@@ -37,6 +37,18 @@ from jumbo_mae_tpu_tpu.models.layers import (
 from jumbo_mae_tpu_tpu.ops.masking import random_masking
 
 
+def pool_tokens(tokens: jax.Array, num_cls_tokens: int, pooling: str = "cls"):
+    """The probe/head representation: ``"cls"`` concatenates the
+    ``num_cls_tokens`` CLS embeddings (parity:
+    ``/root/reference/src/modeling.py:269-274``); ``"gap"`` mean-pools the
+    patch tokens. Shared by :class:`JumboViT` and
+    ``tools/extract_features.py`` so the exported features can never drift
+    from what the in-train heads consume."""
+    if pooling == "gap":
+        return tokens[:, num_cls_tokens:, :].mean(axis=1)
+    return tokens[:, :num_cls_tokens, :].reshape(tokens.shape[0], -1)
+
+
 class JumboViT(nn.Module):
     cfg: JumboViTConfig
 
@@ -117,8 +129,5 @@ class JumboViT(nn.Module):
         if cfg.linear_probing:
             x = jax.lax.stop_gradient(x)
 
-        if cfg.pooling == "gap":
-            pooled = x[:, k:, :].mean(axis=1)
-        else:
-            pooled = x[:, :k, :].reshape(bs, k * cfg.dim)
+        pooled = pool_tokens(x, k, cfg.pooling)
         return self.head(pooled.astype(jnp.float32), deterministic)

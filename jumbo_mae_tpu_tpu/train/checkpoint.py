@@ -156,15 +156,27 @@ class Checkpointer:
         self.cfg = cfg
         root = checkpoint_root(cfg.directory)
         opts = dict(enable_async_checkpointing=cfg.async_save)
+        # the explicit handler registry lets item_metadata work in FRESH
+        # processes (resume), which the dtype-cast warning depends on —
+        # without it orbax returns None metadata and the check degrades
+        handlers = dict(
+            state=ocp.StandardCheckpointHandler(),
+            extra=ocp.JsonCheckpointHandler(),
+        )
         self._last = ocp.CheckpointManager(
             root / "last",
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=cfg.max_keep_last, **opts
             ),
+            item_handlers=handlers,
         )
         self._best = ocp.CheckpointManager(
             root / "best",
             options=ocp.CheckpointManagerOptions(max_to_keep=1, **opts),
+            item_handlers=dict(
+                state=ocp.StandardCheckpointHandler(),
+                extra=ocp.JsonCheckpointHandler(),
+            ),
         )
         self._best_metric = self._read_best_metric()
 
@@ -225,6 +237,18 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._last.latest_step()
 
+    def _resolve(self, which: str, step: int | None):
+        """(manager, concrete step) for ``which`` in {"last", "best"};
+        raises FileNotFoundError when nothing is saved."""
+        mgr = self._last if which == "last" else self._best
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no '{which}' checkpoint under {self.cfg.directory}"
+            )
+        return mgr, step
+
     def restore(
         self,
         template,
@@ -236,13 +260,7 @@ class Checkpointer:
         """Restore ``(state, extra)``. ``template`` is a live state or
         eval_shape tree defining structure/dtypes; ``sharding`` (same tree of
         NamedShardings) places arrays directly on the mesh."""
-        mgr = self._last if which == "last" else self._best
-        if step is None:
-            step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(
-                f"no '{which}' checkpoint under {self.cfg.directory}"
-            )
+        mgr, step = self._resolve(which, step)
         tmpl, _ = split_rng_for_save(template)
         abstract = abstract_state(tmpl, sharding)
         _warn_on_dtype_casts(mgr, step, abstract)
@@ -255,6 +273,145 @@ class Checkpointer:
         )
         extra = out["extra"] or {}
         state = rejoin_rng(out["state"], extra.get("_rng_typed", False))
+        return state, extra
+
+    def restore_eval(
+        self, template, *, sharding: Any = None, step: int | None = None,
+        which: str = "last",
+    ):
+        """Restore only what evaluation needs — params, batch_stats, rng and
+        step — grafted into ``template`` (a live TrainState). The
+        checkpoint's optimizer-state bytes are never read (Orbax partial
+        restore) and arrays restore *directly into their mesh shardings*
+        (no single-device staging), so an eval-only process
+        (``run.eval_only``) never pays AdamW's ~2x-params footprint in
+        device memory, host memory, or restore I/O. Pair with a no-op
+        ``tx`` (the template's opt_state is left as-is)."""
+        _, step = self._resolve(which, step)
+        # a dedicated PyTree-handler manager: partial restore needs PyTree
+        # args (the main managers register Standard handlers — mixing the
+        # two raises a handler-registry conflict), and its metadata feeds
+        # the dtype-cast warning below
+        mgr = ocp.CheckpointManager(
+            checkpoint_root(self.cfg.directory) / which,
+            item_handlers=dict(
+                state=ocp.PyTreeCheckpointHandler(),
+                extra=ocp.JsonCheckpointHandler(),
+            ),
+        )
+        try:
+            return self._restore_eval_impl(mgr, step, template, sharding)
+        finally:
+            mgr.close()
+
+    def _restore_eval_impl(self, mgr, step, template, sharding):
+        # one abstract (shape/dtype) walk per subtree feeds BOTH the restore
+        # item and the same silent-downcast warning restore() emits (e.g.
+        # f32 checkpoint into an optim.param_dtype=bfloat16 eval config)
+        abstract = {
+            attr: abstract_state(getattr(template, attr))
+            for attr in ("params", "batch_stats")
+            if getattr(template, attr) is not None
+        }
+        _warn_on_dtype_casts(mgr, step, abstract)
+
+        def arr_args(attr):
+            shard_tree = getattr(sharding, attr, None)
+            if shard_tree is not None:
+                return jax.tree_util.tree_map(
+                    lambda t, sh: ocp.ArrayRestoreArgs(
+                        sharding=sh, dtype=t.dtype
+                    ),
+                    abstract[attr],
+                    shard_tree,
+                )
+            return jax.tree_util.tree_map(
+                lambda t: ocp.RestoreArgs(restore_type=np.ndarray),
+                abstract[attr],
+            )
+
+        item: dict[str, Any] = {
+            attr: arr_args(attr) for attr in abstract
+        }
+        item["step"] = ocp.RestoreArgs(restore_type=np.ndarray)
+        item["rng"] = ocp.RestoreArgs(restore_type=np.ndarray)
+        try:
+            out = mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.PyTreeRestore(
+                        item=item, partial_restore=True
+                    ),
+                    extra=ocp.args.JsonRestore(),
+                ),
+            )
+        except (TypeError, ValueError) as e:
+            # structural divergence surfaces as an opaque Orbax tree error —
+            # re-raise with the actionable diagnosis (mismatches that Orbax
+            # instead silently fills are caught in graft() below)
+            raise ValueError(
+                "eval config's state does not match the checkpoint — check "
+                "model.preset/overrides and run.mode against the run that "
+                f"produced it (orbax: {e})"
+            ) from e
+        raw = out["state"]
+        extra = out["extra"] or {}
+
+        def graft(attr):
+            tmpl = getattr(template, attr)
+            saved = raw.get(attr) if isinstance(raw, dict) else None
+            if tmpl is None or saved is None:
+                return tmpl
+            # partial_restore fills template paths ABSENT from the
+            # checkpoint with the RestoreArgs leaves themselves — surface a
+            # readable model/checkpoint mismatch instead of letting those
+            # objects reach jit (restore() raises a clear structure error
+            # on the same mismatch; restore_eval must not be weaker)
+            missing = [
+                jax.tree_util.keystr(path)
+                for path, leaf in jax.tree_util.tree_flatten_with_path(saved)[0]
+                if isinstance(leaf, ocp.RestoreArgs)
+            ]
+            if missing:
+                head = ", ".join(missing[:5])
+                raise ValueError(
+                    f"eval config's {attr} does not match the checkpoint — "
+                    f"{len(missing)} paths missing from the saved tree "
+                    f"(first: {head}); check model.preset/overrides against "
+                    "the run that produced the checkpoint"
+                )
+            if getattr(sharding, attr, None) is not None:
+                return saved  # already mesh-sharded + template-dtype
+            # host-side dtype cast (no device staging); placement is jit's
+            return jax.tree_util.tree_map(
+                lambda t, r: np.asarray(r).astype(t.dtype), tmpl, saved
+            )
+
+        rng = template.rng
+        saved_rng = raw.get("rng") if isinstance(raw, dict) else None
+        if saved_rng is not None:
+            rng = (
+                jax.random.wrap_key_data(jnp.asarray(saved_rng))
+                if extra.get("_rng_typed", False)
+                else jnp.asarray(saved_rng)
+            )
+            rng_sharding = getattr(sharding, "rng", None)
+            if rng_sharding is not None:
+                rng = jax.device_put(rng, rng_sharding)
+        new_step = template.step
+        if isinstance(raw, dict) and raw.get("step") is not None:
+            new_step = jnp.asarray(
+                raw["step"], getattr(template.step, "dtype", jnp.int32)
+            )
+            step_sharding = getattr(sharding, "step", None)
+            if step_sharding is not None:
+                new_step = jax.device_put(new_step, step_sharding)
+        state = template.replace(
+            step=new_step,
+            params=graft("params"),
+            batch_stats=graft("batch_stats"),
+            rng=rng,
+        )
         return state, extra
 
     def wait(self):
@@ -293,6 +450,10 @@ def _warn_on_dtype_casts(mgr, step, abstract):
     that, best-effort — metadata layouts vary across Orbax versions."""
     try:
         meta = mgr.item_metadata(step)["state"]
+        if meta is None:
+            # happens on managers without a handler registry — land in the
+            # except below rather than comparing against an empty map
+            raise ValueError("no state metadata (handler registry missing)")
         saved = _leaf_dtype_map(meta)
         want = _leaf_dtype_map(abstract)
         casts = {
@@ -375,6 +536,7 @@ def merge_pretrained_params(
     init_params: dict,
     *,
     verbose: bool = True,
+    stats: dict | None = None,
 ) -> dict:
     """Merge ``pretrained`` into ``init_params`` by key path.
 
@@ -385,7 +547,10 @@ def merge_pretrained_params(
     - paths only in ``init_params`` (decoder dropped, new head) → fresh init.
 
     Prints the overlap diagnostics the reference printed
-    (``/root/reference/src/utils.py:154-158``).
+    (``/root/reference/src/utils.py:154-158``). Pass a dict as ``stats`` to
+    receive the ``loaded``/``resized``/``skipped``/``unused`` path lists —
+    callers that must fail on an empty merge (e.g.
+    ``tools/extract_features.py``) check ``stats["loaded"]``.
     """
     src = _flatten(pretrained)
     dst = _flatten(init_params)
@@ -408,6 +573,10 @@ def merge_pretrained_params(
             merged[path] = init_val
             skipped.append(path)
     unused = [p for p in src if p not in dst]
+    if stats is not None:
+        stats.update(
+            loaded=loaded, resized=resized, skipped=skipped, unused=unused
+        )
     if verbose:
         def fmt(paths):
             return sorted("/".join(p) for p in paths)
@@ -428,6 +597,21 @@ def merge_pretrained_params(
 # the encoder lives under "encoder" in MAEPretrainModel trees and "model"
 # in ClassificationModel trees; warm starts cross that boundary.
 _ENCODER_KEYS = ("encoder", "model")
+
+
+def load_params_tree(path: str) -> dict:
+    """Load a raw params tree from any supported checkpoint carrier: an
+    Orbax checkpoint dir (local or ``gs://``), a local ``.msgpack`` file, or
+    a stream URL (``pipe:``, ``http(s)://``, or a remote ``.msgpack``)."""
+    s = str(path)
+    if s.startswith(("pipe:", "http://", "https://")) or (
+        is_remote_path(s) and s.endswith(".msgpack")
+    ):
+        return import_params_msgpack(s)
+    p = checkpoint_root(s)
+    if p.is_dir():
+        return restore_params_any(p)
+    return import_params_msgpack(s)
 
 
 def load_pretrained_params(
@@ -451,18 +635,7 @@ def load_pretrained_params(
     ``.msgpack`` file, or a stream URL (``pipe:``, ``http(s)://``, or any
     remote path ending in ``.msgpack``) carrying a msgpack params file.
     """
-    s = str(path)
-    if s.startswith(("pipe:", "http://", "https://")) or (
-        is_remote_path(s) and s.endswith(".msgpack")
-    ):
-        tree = import_params_msgpack(s)
-    else:
-        p = checkpoint_root(s)
-        if p.is_dir():
-            tree = restore_params_any(p)
-        else:
-            tree = import_params_msgpack(s)
-    tree = serialization.to_state_dict(tree)
+    tree = serialization.to_state_dict(load_params_tree(path))
     init_sd = serialization.to_state_dict(init_params)
 
     def find_encoder(sd):
@@ -486,27 +659,71 @@ def load_pretrained_params(
     return serialization.from_state_dict(init_params, merged)
 
 
+def _restore_params_only(mgr, step) -> dict | None:
+    """Partial restore of the ``params`` subtree alone — the optimizer
+    state's ~2x-params bytes are never read. Needs the saved tree's
+    structure, taken from the checkpoint metadata; returns None when the
+    layout doesn't expose it (caller falls back to a whole-tree restore)."""
+    try:
+        meta = mgr.item_metadata(step)
+        state_meta = None if meta is None else meta.get("state")
+        tree = getattr(state_meta, "tree", state_meta)
+        if not isinstance(tree, dict) or "params" not in tree:
+            return None
+        item = {
+            "params": jax.tree_util.tree_map(
+                lambda _: ocp.RestoreArgs(restore_type=np.ndarray),
+                tree["params"],
+            )
+        }
+        out = mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.PyTreeRestore(item=item, partial_restore=True)
+            ),
+        )
+        return out["state"]["params"]
+    except Exception:
+        return None
+
+
 def restore_params_any(directory) -> dict:
     """Restore just the params tree from a Checkpointer layout (best/ or
     last/ subdirs, or a direct manager dir). ``directory`` may be local or a
-    ``gs://`` URL (routed through :func:`checkpoint_root`)."""
+    ``gs://`` URL (routed through :func:`checkpoint_root`). TrainState
+    layouts restore the params subtree only (optimizer bytes skipped);
+    other layouts fall back to a whole-tree restore."""
     directory = checkpoint_root(directory)
     for sub in ("best", "last", "."):
         root = directory if sub == "." else directory / sub
-        if root.is_dir():
-            with ocp.CheckpointManager(root) as mgr:
-                step = mgr.latest_step()
-                if step is None:
-                    continue
-                out = mgr.restore(
-                    step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
-                )
-                state = out["state"]
-                params = (
-                    state.get("params") if isinstance(state, dict) else state.params
-                )
-                if params is not None:
-                    return params
+        if not root.is_dir():
+            continue
+        # params-only partial restore needs the saved tree structure, which
+        # item_metadata only exposes with an explicit handler registry
+        with ocp.CheckpointManager(
+            root,
+            item_handlers={
+                "state": ocp.PyTreeCheckpointHandler(),
+                "extra": ocp.JsonCheckpointHandler(),
+            },
+        ) as mgr:
+            step = mgr.latest_step()
+            if step is None:
+                continue
+            params = _restore_params_only(mgr, step)
+            if params is not None:
+                return params
+        # fallback: whole-tree restore on a plain manager (legacy layouts)
+        with ocp.CheckpointManager(root) as mgr:
+            out = mgr.restore(
+                step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+            )
+            state = out["state"]
+            params = (
+                state.get("params") if isinstance(state, dict) else state.params
+            )
+            if params is not None:
+                return params
     raise FileNotFoundError(f"no restorable checkpoint under {directory}")
 
 

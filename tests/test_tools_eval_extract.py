@@ -1,0 +1,243 @@
+"""run.eval_only mode + tools/extract_features.py (beyond-reference
+capabilities: the reference evaluates only inline in its train loop and has
+no feature-export path)."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.config import load_config
+
+REPO = Path(__file__).resolve().parent.parent
+RECIPES = REPO / "recipes"
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def _smoke_overrides(out, extra=()):
+    return [
+        f"run.output_dir={out}",
+        "run.training_steps=4",
+        "run.eval_interval=4",
+        "run.log_interval=4",
+        "run.sanity_eval=false",
+        # big steps so 4 of them move the weights measurably — the
+        # fresh-init negative control below needs trained != init
+        "optim.learning_rate=3e-2",
+        "optim.warmup_steps=0",
+        *extra,
+    ]
+
+
+@pytest.mark.slow
+def test_eval_only_restores_and_matches_training_eval(tmp_path):
+    """Train 4 steps (checkpoint saved at the end), then run eval_only with
+    run.resume=true: it must restore the trained weights and reproduce the
+    training run's final val/loss exactly (same weights, same eval stream,
+    no training steps in between)."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    out = tmp_path / "run"
+    trained = train(load_config(RECIPES / "smoke_cpu.yaml", _smoke_overrides(out)))
+    assert "val/loss" in trained
+
+    evaled = train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml",
+            _smoke_overrides(out, ["run.eval_only=true", "run.resume=true"]),
+        )
+    )
+    assert set(evaled) == {"val/loss"}
+    assert evaled["val/loss"] == pytest.approx(trained["val/loss"], rel=1e-6)
+
+    # fresh-init eval (no restore) must differ — proves the restore mattered
+    fresh = train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml",
+            _smoke_overrides(tmp_path / "fresh", ["run.eval_only=true"]),
+        )
+    )
+    assert fresh["val/loss"] != pytest.approx(trained["val/loss"], rel=1e-4)
+
+
+@pytest.mark.slow
+def test_eval_only_linear_mode_grafts_batch_stats(tmp_path):
+    """Linear-probe eval_only: restore_eval must graft BatchNorm
+    batch_stats (not just params) — acc/loss reproduce the training run's
+    final eval exactly."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    out = tmp_path / "lin"
+    extra = ["run.mode=linear", "model.overrides.labels=10"]
+    trained = train(
+        load_config(RECIPES / "smoke_cpu.yaml", _smoke_overrides(out, extra))
+    )
+    assert "val/acc1" in trained
+
+    evaled = train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml",
+            _smoke_overrides(
+                out, extra + ["run.eval_only=true", "run.resume=true"]
+            ),
+        )
+    )
+    for key in ("val/loss", "val/acc1", "val/acc5"):
+        assert evaled[key] == pytest.approx(trained[key], rel=1e-6), key
+
+
+@pytest.mark.slow
+def test_eval_only_model_mismatch_raises(tmp_path):
+    """eval_only+resume with a DIFFERENT model than the checkpoint's must
+    raise a readable mismatch error, not push RestoreArgs leaves into jit
+    (regression: Orbax partial_restore fills missing paths with the item's
+    own RestoreArgs objects)."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    out = tmp_path / "run"
+    train(load_config(RECIPES / "smoke_cpu.yaml", _smoke_overrides(out)))
+
+    with pytest.raises(ValueError, match="does not match the checkpoint"):
+        train(
+            load_config(
+                RECIPES / "smoke_cpu.yaml",
+                _smoke_overrides(
+                    out,
+                    [
+                        "run.eval_only=true",
+                        "run.resume=true",
+                        # classify-mode tree ('model' root) vs the saved
+                        # pretrain tree ('encoder' root)
+                        "run.mode=linear",
+                        "model.overrides.labels=10",
+                    ],
+                ),
+            )
+        )
+
+
+def test_eval_only_resume_without_checkpoint_raises(tmp_path):
+    """An explicit run.resume=true that can't be satisfied must raise, not
+    silently evaluate a random init (regression)."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    cfg = load_config(
+        RECIPES / "smoke_cpu.yaml",
+        _smoke_overrides(
+            tmp_path / "nothing_here",
+            ["run.eval_only=true", "run.resume=true"],
+        ),
+    )
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        train(cfg)
+
+
+def test_eval_only_requires_valid_data(tmp_path):
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    cfg = load_config(
+        RECIPES / "smoke_cpu.yaml",
+        _smoke_overrides(
+            tmp_path, ["run.eval_only=true", "run.synthetic_data=false"]
+        ),
+    )
+    with pytest.raises(ValueError, match="eval_only"):
+        train(cfg)
+
+
+def test_extract_features_pools_and_ckpt_restore(tmp_path):
+    """Shapes per pool mode; determinism; --ckpt actually changes the
+    features (pretrain-tree 'encoder' subtree mapped onto the bare
+    encoder)."""
+    import jax
+
+    from extract_features import main as extract_main
+    from jumbo_mae_tpu_tpu.models import MAEPretrainModel, preset
+    from jumbo_mae_tpu_tpu.models.config import DecoderConfig
+    from jumbo_mae_tpu_tpu.train.checkpoint import export_params_msgpack
+
+    base = [
+        str(RECIPES / "smoke_cpu.yaml"),
+        "--set",
+        "run.synthetic_data=true",
+        "run.valid_batch_size=8",
+    ]
+
+    cls = np.load(
+        extract_main(base + ["--out", str(tmp_path / "cls.npz"), "--pool", "cls"])
+    )
+    gap = np.load(
+        extract_main(base + ["--out", str(tmp_path / "gap.npz"), "--pool", "gap"])
+    )
+    cfg = load_config(RECIPES / "smoke_cpu.yaml")
+    enc = preset(
+        cfg.model.preset,
+        **{**cfg.model.overrides, "labels": None, "mask_ratio": None},
+    )
+    k, d = enc.num_cls_tokens, enc.dim
+    assert cls["features"].shape == (32, k * d)
+    assert gap["features"].shape == (32, d)
+    assert np.isfinite(cls["features"]).all()
+
+    # determinism: same invocation → identical bytes
+    cls2 = np.load(
+        extract_main(base + ["--out", str(tmp_path / "cls2.npz"), "--pool", "cls"])
+    )
+    np.testing.assert_array_equal(cls["features"], cls2["features"])
+
+    # a classify recipe with model.overrides.labels must not collide with
+    # the tool's forced headless config (regression: keyword collision)
+    lab = np.load(
+        extract_main(
+            base
+            + ["model.overrides.labels=10", "--out", str(tmp_path / "lab.npz")]
+        )
+    )
+    assert lab["features"].shape == cls["features"].shape
+
+    # --ckpt: export a differently-seeded pretrain tree and restore it
+    enc_mae = enc.replace(mask_ratio=0.75)
+    mae = MAEPretrainModel(enc_mae, DecoderConfig(layers=1, dim=32, heads=4))
+    rng = jax.random.PRNGKey(123)
+    variables = mae.init(
+        {"params": rng, "noise": rng, "dropout": rng},
+        np.zeros((1, cfg.data.image_size, cfg.data.image_size, 3), np.uint8),
+    )
+    ckpt_path = tmp_path / "pretrain.msgpack"
+    export_params_msgpack(variables["params"], str(ckpt_path))
+
+    warm = np.load(
+        extract_main(
+            base
+            + ["--out", str(tmp_path / "warm.npz"), "--pool", "cls", "--ckpt", str(ckpt_path)]
+        )
+    )
+    assert warm["features"].shape == cls["features"].shape
+    assert not np.allclose(warm["features"], cls["features"])
+
+    # an unrelated tree (wrong preset/shapes) must refuse to write rather
+    # than silently export random-init features
+    import flax.linen as fnn
+
+    junk = fnn.Dense(7).init(rng, np.zeros((1, 3), np.float32))["params"]
+    junk_path = tmp_path / "junk.msgpack"
+    export_params_msgpack(junk, str(junk_path))
+    with pytest.raises(SystemExit, match="0 params"):
+        extract_main(
+            base
+            + ["--out", str(tmp_path / "junk.npz"), "--ckpt", str(junk_path)]
+        )
+    assert not (tmp_path / "junk.npz").exists()
+
+    # a BARE encoder tree (no 'encoder'/'model' nesting) must load too —
+    # and land on the same features as the nested pretrain tree it came from
+    bare_path = tmp_path / "bare.msgpack"
+    export_params_msgpack(variables["params"]["encoder"], str(bare_path))
+    bare = np.load(
+        extract_main(
+            base
+            + ["--out", str(tmp_path / "bare.npz"), "--pool", "cls", "--ckpt", str(bare_path)]
+        )
+    )
+    np.testing.assert_array_equal(bare["features"], warm["features"])

@@ -1,0 +1,178 @@
+#!/usr/bin/env python3
+"""Export frozen jumbo-encoder features for a dataset split.
+
+Beyond the reference (which has no feature-export path — its frozen-trunk
+consumers are the inline linear/finetune modes, ``/root/reference/src/
+main_finetune.py``): restore a checkpoint, run the encoder deterministically
+(no masking, no dropout) over the validation split — or synthetic data —
+and write an ``.npz`` of pooled features plus labels where present.
+
+    python tools/extract_features.py recipes/linear_sgd_vit_b16.yaml \
+        --ckpt runs/pretrain/ckpt --out feats.npz --pool cls \
+        [--set data.valid_shards=...]
+
+``--pool cls`` is the reference's probe representation (the 3 CLS tokens
+concatenated, ``/root/reference/src/modeling.py:269-274``); ``gap`` mean-pools
+the patch tokens; ``tokens`` exports the full normed token sequence.
+``--ckpt`` accepts an Orbax run/checkpoint directory or a ``.msgpack`` params
+file (either a pretrain tree with an ``encoder`` subtree, a classification
+tree with a ``model`` subtree, or a bare encoder tree).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("recipe", nargs="?", default=None, help="YAML recipe path")
+    p.add_argument(
+        "--ckpt",
+        default="",
+        help="Orbax checkpoint dir or .msgpack params; random init if omitted",
+    )
+    p.add_argument("--out", required=True, help="output .npz path")
+    p.add_argument("--pool", choices=("cls", "gap", "tokens"), default="cls")
+    p.add_argument(
+        "--set",
+        dest="overrides",
+        metavar="KEY.PATH=VALUE",
+        nargs="*",
+        action="extend",
+        default=[],
+        help="dotted config overrides, same grammar as cli.train",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> Path:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from jumbo_mae_tpu_tpu.cli.train import make_valid_iterator
+    from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.models import JumboViT, pool_tokens, preset
+    from jumbo_mae_tpu_tpu.ops.preprocess import normalize_images
+    from jumbo_mae_tpu_tpu.parallel import create_mesh
+    from jumbo_mae_tpu_tpu.train.checkpoint import (
+        _ENCODER_KEYS,
+        load_params_tree,
+        merge_pretrained_params,
+    )
+
+    if jax.process_count() > 1:
+        raise SystemExit(
+            "extract_features is a single-process tool; run it on one host"
+        )
+
+    cfg = load_config(args.recipe, args.overrides)
+    m = cfg.model
+    # the recipe's label count (read before the head is forced off below) —
+    # synthetic-data label export must match the recipe's class space
+    recipe_labels = m.overrides.get("labels")
+    enc_cfg = preset(
+        m.preset,
+        # forced last so recipe overrides (labels, mask_ratio for pretrain
+        # recipes, stochastic knobs) can't re-enable a head/masking/dropout
+        **{
+            **m.overrides,
+            "labels": None,
+            "mask_ratio": None,
+            "dropout": 0.0,
+            "droppath": 0.0,
+        },
+    )
+    model = JumboViT(enc_cfg)
+    mesh = create_mesh(cfg.mesh)
+
+    per_batch = max(1, cfg.run.valid_batch_size)
+    size = cfg.data.image_size
+    example = jnp.zeros((1, size, size, 3), jnp.uint8)
+    params = model.init(
+        jax.random.PRNGKey(cfg.run.init_seed),
+        normalize_images(example, dtype=enc_cfg.compute_dtype),
+        True,
+    )["params"]
+    if args.ckpt:
+        from flax import serialization
+
+        # pretrain trees keep the encoder under "encoder", classification
+        # trees under "model", a bare encoder export has neither — map any
+        # of the three onto this bare encoder before merging
+        tree = serialization.to_state_dict(load_params_tree(args.ckpt))
+        src = next((key for key in _ENCODER_KEYS if key in tree), None)
+        stats: dict = {}
+        merged = merge_pretrained_params(
+            tree[src] if src else tree,
+            serialization.to_state_dict(params),
+            stats=stats,
+        )
+        if not (stats["loaded"] or stats["resized"]):
+            # writing plausible-looking random-init features would be worse
+            # than failing — mirror cli.train's fail-fast on unsatisfiable
+            # restores
+            raise SystemExit(
+                f"--ckpt {args.ckpt} loaded 0 params into the {m.preset} "
+                "encoder — wrong preset/shape or an unrelated params tree"
+            )
+        params = serialization.from_state_dict(params, merged)
+
+    k = enc_cfg.num_cls_tokens
+
+    @jax.jit
+    def fwd(params, images):
+        x = normalize_images(images, dtype=enc_cfg.compute_dtype)
+        tokens = model.apply({"params": params}, x, True)
+        feats = tokens if args.pool == "tokens" else pool_tokens(tokens, k, args.pool)
+        return feats.astype(jnp.float32)
+
+    valid_factory = make_valid_iterator(
+        cfg, mesh, per_batch, num_labels=recipe_labels or 1000
+    )
+    if valid_factory is None:
+        raise SystemExit(
+            "no data: set data.valid_shards or run.synthetic_data=true"
+        )
+
+    all_feats: list[np.ndarray] = []
+    all_labels: list[np.ndarray] = []
+    for batch in valid_factory():
+        feats = np.asarray(jax.device_get(fwd(params, batch["images"])))
+        valid = np.asarray(
+            jax.device_get(batch.get("valid", np.ones(feats.shape[0], bool)))
+        ).astype(bool)
+        all_feats.append(feats[valid])
+        if "labels" in batch:
+            labels = np.asarray(jax.device_get(batch["labels"]))
+            all_labels.append(labels[valid])
+
+    total = sum(f.shape[0] for f in all_feats)
+    if total == 0:
+        raise SystemExit(
+            "no valid samples in the stream — check data.valid_shards "
+            "matches non-empty shards (or run.synthetic_data=true)"
+        )
+    out = Path(args.out)
+    payload = {
+        "features": np.concatenate(all_feats, axis=0),
+        "pool": np.asarray(args.pool),
+    }
+    if all_labels:
+        payload["labels"] = np.concatenate(all_labels, axis=0)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(out, **payload)
+    n, shape = payload["features"].shape[0], payload["features"].shape[1:]
+    print(f"[extract] wrote {n} x {shape} {args.pool} features -> {out}")
+    return out
+
+
+if __name__ == "__main__":
+    main()
