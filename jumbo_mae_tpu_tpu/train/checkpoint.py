@@ -594,6 +594,18 @@ def merge_pretrained_params(
     return _unflatten(merged)
 
 
+def require_loaded(stats: dict, source, target_desc: str):
+    """CLI-tool guard: exit unless a ``merge_pretrained_params`` call (via
+    its ``stats`` out-param) actually loaded something — writing
+    plausible-looking random-init artifacts is worse than failing. Shared
+    by ``tools/extract_features.py`` and ``tools/reconstruct.py``."""
+    if not (stats.get("loaded") or stats.get("resized")):
+        raise SystemExit(
+            f"--ckpt {source} loaded 0 params into {target_desc} — "
+            "wrong preset/shape or an unrelated params tree"
+        )
+
+
 # the encoder lives under "encoder" in MAEPretrainModel trees and "model"
 # in ClassificationModel trees; warm starts cross that boundary.
 _ENCODER_KEYS = ("encoder", "model")
@@ -620,6 +632,7 @@ def load_pretrained_params(
     *,
     subtree: str | None = "auto",
     verbose: bool = True,
+    stats: dict | None = None,
 ) -> dict:
     """Load pretrained params from an Orbax checkpoint dir or a ``.msgpack``
     file and merge into ``init_params`` (parity:
@@ -652,10 +665,12 @@ def load_pretrained_params(
     if src_key is not None and dst_key is not None:
         merged = dict(init_sd)
         merged[dst_key] = merge_pretrained_params(
-            tree[src_key], init_sd[dst_key], verbose=verbose
+            tree[src_key], init_sd[dst_key], verbose=verbose, stats=stats
         )
     else:
-        merged = merge_pretrained_params(tree, init_sd, verbose=verbose)
+        merged = merge_pretrained_params(
+            tree, init_sd, verbose=verbose, stats=stats
+        )
     return serialization.from_state_dict(init_params, merged)
 
 

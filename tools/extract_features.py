@@ -66,6 +66,7 @@ def main(argv: list[str] | None = None) -> Path:
         _ENCODER_KEYS,
         load_params_tree,
         merge_pretrained_params,
+        require_loaded,
     )
 
     if jax.process_count() > 1:
@@ -115,14 +116,7 @@ def main(argv: list[str] | None = None) -> Path:
             serialization.to_state_dict(params),
             stats=stats,
         )
-        if not (stats["loaded"] or stats["resized"]):
-            # writing plausible-looking random-init features would be worse
-            # than failing — mirror cli.train's fail-fast on unsatisfiable
-            # restores
-            raise SystemExit(
-                f"--ckpt {args.ckpt} loaded 0 params into the {m.preset} "
-                "encoder — wrong preset/shape or an unrelated params tree"
-            )
+        require_loaded(stats, args.ckpt, f"the {m.preset} encoder")
         params = serialization.from_state_dict(params, merged)
 
     k = enc_cfg.num_cls_tokens

@@ -1,0 +1,181 @@
+#!/usr/bin/env python3
+"""Render MAE reconstructions as a side-by-side image grid.
+
+The canonical MAE demo figure (original | masked input | reconstruction |
+reconstruction+visible pasted) — beyond the reference, which computes the
+masked loss but never renders predictions. Pixel predictions come from the
+model's ``return_reconstruction`` path (``models/mae.py``); with
+``norm_pix_loss`` the per-patch normalization is inverted using the target
+patch statistics (the standard MAE visualization convention, since the
+model predicts in normalized-patch space).
+
+    python tools/reconstruct.py recipes/pretrain_vit_l16_in1k_800ep.yaml \
+        --ckpt runs/x/ckpt --out recon.png --n 8 \
+        [--set data.valid_shards=... | run.synthetic_data=true] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("recipe", nargs="?", default=None, help="YAML recipe path")
+    p.add_argument(
+        "--ckpt",
+        default="",
+        help="Orbax checkpoint dir or .msgpack params; random init if omitted",
+    )
+    p.add_argument("--out", required=True, help="output .png path")
+    p.add_argument("--n", type=int, default=8, help="images in the grid")
+    p.add_argument("--seed", type=int, default=0, help="masking seed")
+    p.add_argument(
+        "--set",
+        dest="overrides",
+        metavar="KEY.PATH=VALUE",
+        nargs="*",
+        action="extend",
+        default=[],
+        help="dotted config overrides, same grammar as cli.train",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> Path:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from PIL import Image
+
+    from jumbo_mae_tpu_tpu.cli.train import build_model, make_valid_iterator
+    from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.ops.patches import extract_patches, merge_patches
+    from jumbo_mae_tpu_tpu.ops.preprocess import (
+        IMAGENET_MEAN,
+        IMAGENET_STD,
+        normalize_images,
+    )
+    from jumbo_mae_tpu_tpu.parallel import create_mesh
+    from jumbo_mae_tpu_tpu.train.checkpoint import (
+        load_pretrained_params,
+        require_loaded,
+    )
+
+    if jax.process_count() > 1:
+        raise SystemExit(
+            "reconstruct is a single-process tool; run it on one host"
+        )
+
+    cfg = load_config(args.recipe, args.overrides)
+    if cfg.run.mode != "pretrain":
+        raise SystemExit("reconstruction needs a pretrain recipe (run.mode=pretrain)")
+    model, enc_cfg, _ = build_model(cfg)
+    patch = enc_cfg.patch_size
+
+    size = cfg.data.image_size
+    example = np.zeros((1, size, size, 3), np.uint8)
+    variables = model.init(
+        {
+            "params": jax.random.PRNGKey(cfg.run.init_seed),
+            "noise": jax.random.PRNGKey(0),
+            "dropout": jax.random.PRNGKey(0),
+        },
+        example,
+    )
+    params = variables["params"]
+    if args.ckpt:
+        # whole-tree merge: the decoder/mask_token/pixel_proj weights are
+        # exactly what reconstruction needs (the default "auto" subtree mode
+        # would warm-start the encoder only and leave the decoder random)
+        stats: dict = {}
+        params = load_pretrained_params(
+            args.ckpt, params, subtree=None, stats=stats
+        )
+        require_loaded(
+            stats, args.ckpt, f"the {cfg.model.preset} pretrain model"
+        )
+
+    mesh = create_mesh(cfg.mesh)
+    # the device-prefetch sharding needs the batch divisible by the mesh's
+    # data axes — round up and slice the n requested rows host-side
+    n_dev = len(jax.devices())
+    per_batch = -(-max(1, args.n) // n_dev) * n_dev
+    valid_factory = make_valid_iterator(
+        cfg, mesh, per_batch, num_labels=enc_cfg.labels or 1000
+    )
+    if valid_factory is None:
+        raise SystemExit("no data: set data.valid_shards or run.synthetic_data=true")
+    batch = next(iter(valid_factory()))
+    images = np.asarray(jax.device_get(batch["images"]))[: args.n]
+    if images.shape[0] == 0:
+        raise SystemExit("empty validation stream")
+
+    @jax.jit
+    def recon(params, images, noise_key):
+        out = model.apply(
+            {"params": params},
+            images,
+            True,
+            True,
+            rngs={"noise": noise_key},
+        )
+        return out["reconstruction"], out["mask"]
+
+    pred, mask = recon(params, images, jax.random.PRNGKey(args.seed))
+    pred = np.asarray(pred, np.float32)  # (B, N, p*p*3), maybe norm-pix space
+    mask = np.asarray(mask, np.float32)[..., None]  # (B, N, 1); 1 = masked
+
+    norm = np.asarray(
+        normalize_images(jnp.asarray(images), dtype=jnp.float32), np.float32
+    )
+    target = np.asarray(
+        extract_patches(jnp.asarray(norm), patch), np.float32
+    )  # (B, N, p*p*3)
+    if cfg.model.norm_pix_loss:
+        mean = target.mean(axis=-1, keepdims=True)
+        var = target.var(axis=-1, keepdims=True)
+        pred = pred * np.sqrt(var + 1e-6) + mean
+
+    def to_uint8(patches: np.ndarray) -> np.ndarray:
+        """(B, N, p*p*3) normalized patches → (B, H, W, 3) uint8 images."""
+        img = np.asarray(merge_patches(jnp.asarray(patches), patch), np.float32)
+        img = (img * IMAGENET_STD + IMAGENET_MEAN) * 255.0
+        return np.clip(img, 0, 255).astype(np.uint8)
+
+    panels = [
+        images,  # original
+        # zeroed normalized patches render as ImageNet-mean gray
+        to_uint8(target * (1.0 - mask)),  # masked input
+        to_uint8(pred),  # full reconstruction
+        to_uint8(target * (1.0 - mask) + pred * mask),  # paste: visible + pred
+    ]
+
+    n, h, w = images.shape[0], images.shape[1], images.shape[2]
+    pad = 2
+    grid = np.full(
+        (n * (h + pad) - pad, len(panels) * (w + pad) - pad, 3), 255, np.uint8
+    )
+    for row in range(n):
+        for col, panel in enumerate(panels):
+            y, x = row * (h + pad), col * (w + pad)
+            grid[y : y + h, x : x + w] = panel[row]
+
+    out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    Image.fromarray(grid).save(out)
+    print(
+        f"[reconstruct] wrote {n}x{len(panels)} grid "
+        f"(original | masked | reconstruction | paste) -> {out}"
+    )
+    return out
+
+
+if __name__ == "__main__":
+    main()
