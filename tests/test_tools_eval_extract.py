@@ -201,6 +201,67 @@ def test_reconstruct_grid(tmp_path):
     assert not (tmp_path / "junk.png").exists()
 
 
+def test_reconstruct_from_image_files(tmp_path):
+    """--images bypasses the data pipeline: arbitrary files are resized +
+    center-cropped to the model input and rendered."""
+    from PIL import Image
+
+    from reconstruct import main as reconstruct_main
+
+    rng = np.random.default_rng(0)
+    files = []
+    for i, shape in enumerate([(60, 80, 3), (100, 40, 3)]):
+        f = tmp_path / f"im{i}.png"
+        Image.fromarray(rng.integers(0, 256, shape, dtype=np.uint8)).save(f)
+        files.append(str(f))
+
+    out = reconstruct_main(
+        [
+            str(RECIPES / "smoke_cpu.yaml"),
+            "--out",
+            str(tmp_path / "user.png"),
+            "--images",
+            *files,
+        ]
+    )
+    cfg = load_config(RECIPES / "smoke_cpu.yaml")
+    size, pad = cfg.data.image_size, 2
+    assert Image.open(out).size == (4 * (size + pad) - pad, 2 * (size + pad) - pad)
+
+
+def test_knn_probe_separates_clusters(tmp_path):
+    """kNN probe: near-perfect on well-separated gaussian clusters, chance
+    on shuffled labels; CLI prints the JSON metric line."""
+    from knn_probe import knn_predict, main as knn_main
+
+    rng = np.random.default_rng(0)
+    classes, per, dim = 5, 40, 16
+    centers = rng.standard_normal((classes, dim)) * 4.0
+
+    def make(n_per, seed):
+        r = np.random.default_rng(seed)
+        feats = np.concatenate(
+            [centers[c] + r.standard_normal((n_per, dim)) for c in range(classes)]
+        )
+        labels = np.repeat(np.arange(classes), n_per)
+        return feats.astype(np.float32), labels
+
+    train_f, train_l = make(per, 1)
+    query_f, query_l = make(10, 2)
+    preds = knn_predict(train_f, train_l, query_f, k=10)
+    assert (preds == query_l).mean() > 0.9
+
+    shuffled = train_l.copy()
+    np.random.default_rng(3).shuffle(shuffled)
+    chance = (knn_predict(train_f, shuffled, query_f, k=10) == query_l).mean()
+    assert chance < 0.5
+
+    np.savez(tmp_path / "train.npz", features=train_f, labels=train_l)
+    np.savez(tmp_path / "val.npz", features=query_f, labels=query_l)
+    acc = knn_main([str(tmp_path / "train.npz"), str(tmp_path / "val.npz")])
+    assert acc > 0.9
+
+
 def test_extract_features_pools_and_ckpt_restore(tmp_path):
     """Shapes per pool mode; determinism; --ckpt actually changes the
     features (pretrain-tree 'encoder' subtree mapped onto the bare

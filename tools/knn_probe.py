@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""k-nearest-neighbor probe over exported feature files.
+
+Closes the loop on ``tools/extract_features.py``: a training-free accuracy
+readout of frozen representations (the standard kNN-probe protocol —
+cosine similarity, temperature-weighted vote over the k nearest training
+features), without running the linear-probe optimizer. Beyond the
+reference, whose only probe is the trained BatchNorm+linear head.
+
+    python tools/extract_features.py cfg.yaml --ckpt C --out train.npz \
+        --set data.valid_shards=<train shards>
+    python tools/extract_features.py cfg.yaml --ckpt C --out val.npz
+    python tools/knn_probe.py train.npz val.npz [--k 20] [--temp 0.07]
+
+Both inputs must be ``.npz`` files with ``features`` and ``labels`` arrays
+(as written by extract_features). Prints one JSON line with top-1 accuracy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def knn_predict(
+    train_feats,
+    train_labels,
+    query_feats,
+    *,
+    k: int = 20,
+    temp: float = 0.07,
+    num_classes: int | None = None,
+    block: int = 1024,
+):
+    """Cosine-similarity kNN with temperature-weighted voting.
+
+    Pure numpy (host-side — feature tables are small relative to the
+    model); returns predicted labels for ``query_feats``.
+    """
+    import numpy as np
+
+    def l2norm(x):
+        x = np.asarray(x, np.float32)
+        return x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+
+    train = l2norm(train_feats)
+    query = l2norm(query_feats)
+    labels = np.asarray(train_labels)
+    classes = int(num_classes or labels.max() + 1)
+    k = min(k, train.shape[0])
+
+    preds = []
+    for start in range(0, query.shape[0], block):
+        sim = query[start : start + block] @ train.T  # (b, n_train)
+        top = np.argpartition(-sim, k - 1, axis=1)[:, :k]
+        top_sim = np.take_along_axis(sim, top, axis=1)
+        top_lab = labels[top]
+        weight = np.exp(top_sim / temp)
+        votes = np.zeros((top.shape[0], classes), np.float32)
+        rows = np.repeat(np.arange(top.shape[0]), k)
+        np.add.at(votes, (rows, top_lab.reshape(-1)), weight.reshape(-1))
+        preds.append(votes.argmax(axis=1))
+    return np.concatenate(preds)
+
+
+def main(argv: list[str] | None = None) -> float:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("train_npz", help="features+labels of the reference set")
+    p.add_argument("query_npz", help="features+labels to evaluate")
+    p.add_argument("--k", type=int, default=20)
+    p.add_argument("--temp", type=float, default=0.07)
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    train = np.load(args.train_npz)
+    query = np.load(args.query_npz)
+    for name, z in (("train", train), ("query", query)):
+        if "labels" not in z:
+            raise SystemExit(
+                f"{name} file has no labels — extract from a labeled split"
+            )
+    preds = knn_predict(
+        train["features"], train["labels"], query["features"],
+        k=args.k, temp=args.temp,
+    )
+    acc = float((preds == query["labels"]).mean())
+    print(
+        json.dumps(
+            {
+                "metric": "knn_top1",
+                "value": round(acc, 4),
+                "k": args.k,
+                "temp": args.temp,
+                "n_train": int(train["features"].shape[0]),
+                "n_query": int(query["features"].shape[0]),
+            }
+        )
+    )
+    return acc
+
+
+if __name__ == "__main__":
+    main()

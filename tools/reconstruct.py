@@ -32,8 +32,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="Orbax checkpoint dir or .msgpack params; random init if omitted",
     )
     p.add_argument("--out", required=True, help="output .png path")
-    p.add_argument("--n", type=int, default=8, help="images in the grid")
+    p.add_argument(
+        "--n",
+        type=int,
+        default=None,
+        help="images in the grid (default: 8, or all --images files)",
+    )
     p.add_argument("--seed", type=int, default=0, help="masking seed")
+    p.add_argument(
+        "--images",
+        nargs="+",
+        default=[],
+        metavar="FILE",
+        help="image files (jpeg/png/...) to reconstruct instead of the "
+        "recipe's validation stream; run through the eval transform "
+        "(shorter-side resize to size/crop_ratio + center crop)",
+    )
     p.add_argument(
         "--set",
         dest="overrides",
@@ -102,18 +116,42 @@ def main(argv: list[str] | None = None) -> Path:
             stats, args.ckpt, f"the {cfg.model.preset} pretrain model"
         )
 
-    mesh = create_mesh(cfg.mesh)
-    # the device-prefetch sharding needs the batch divisible by the mesh's
-    # data axes — round up and slice the n requested rows host-side
-    n_dev = len(jax.devices())
-    per_batch = -(-max(1, args.n) // n_dev) * n_dev
-    valid_factory = make_valid_iterator(
-        cfg, mesh, per_batch, num_labels=enc_cfg.labels or 1000
-    )
-    if valid_factory is None:
-        raise SystemExit("no data: set data.valid_shards or run.synthetic_data=true")
-    batch = next(iter(valid_factory()))
-    images = np.asarray(jax.device_get(batch["images"]))[: args.n]
+    if args.images:
+        from jumbo_mae_tpu_tpu.data.transforms import eval_transform
+
+        n = max(1, args.n) if args.n is not None else len(args.images)
+        if len(args.images) > n:
+            print(
+                f"[reconstruct] rendering the first {n} of "
+                f"{len(args.images)} files (--n)"
+            )
+        images = np.stack(
+            [
+                eval_transform(
+                    np.asarray(Image.open(f).convert("RGB"), np.uint8),
+                    size,
+                    crop_ratio=cfg.data.test_crop_ratio,
+                )
+                for f in args.images[:n]
+            ]
+        )
+    else:
+        n = args.n if args.n is not None else 8
+        mesh = create_mesh(cfg.mesh)
+        # the device-prefetch sharding needs the batch divisible by the
+        # mesh's data axes — round up and slice the n requested rows
+        n_dev = len(jax.devices())
+        per_batch = -(-max(1, n) // n_dev) * n_dev
+        valid_factory = make_valid_iterator(
+            cfg, mesh, per_batch, num_labels=enc_cfg.labels or 1000
+        )
+        if valid_factory is None:
+            raise SystemExit(
+                "no data: pass --images, set data.valid_shards, or "
+                "run.synthetic_data=true"
+            )
+        batch = next(iter(valid_factory()))
+        images = np.asarray(jax.device_get(batch["images"]))[:n]
     if images.shape[0] == 0:
         raise SystemExit("empty validation stream")
 
