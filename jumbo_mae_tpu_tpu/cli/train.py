@@ -399,6 +399,18 @@ def train(cfg: TrainConfig) -> dict:
             "run.eval_only requires validation data "
             "(data.valid_shards or run.synthetic_data)"
         )
+    if run.eval_which not in ("last", "best"):
+        raise ValueError(
+            f"run.eval_which must be 'last' or 'best', got {run.eval_which!r}"
+        )
+    if run.eval_which != "last" and not (run.eval_only and run.resume):
+        # never silently drop a knob: slot selection only has an effect on
+        # the eval_only+resume restore (pretrained_ckpt goes through the
+        # warm-start merge, training resume is defined as 'last')
+        raise ValueError(
+            "run.eval_which=best requires run.eval_only=true AND "
+            "run.resume=true (other paths would silently ignore it)"
+        )
 
     cfg.mesh.validate_pipe()
     pipe_microbatches = 0
@@ -450,14 +462,20 @@ def train(cfg: TrainConfig) -> dict:
         if run.eval_only and not run.resume
         else Checkpointer(cfg.checkpoint_config())
     )
-    resuming = run.resume and ckpt is not None and ckpt.latest_step() is not None
+    # the top-of-train guard pins eval_which to "last" outside eval_only
+    eval_which = run.eval_which
+    resuming = (
+        run.resume
+        and ckpt is not None
+        and ckpt.latest_step(eval_which) is not None
+    )
     if run.eval_only and run.resume and not resuming:
         # an explicit restore request that can't be satisfied must not fall
         # through to plausible-looking random-init metrics
         ckpt.close()
         raise FileNotFoundError(
-            "run.eval_only with run.resume=true but no checkpoint "
-            f"under {cfg.checkpoint_config().directory}"
+            f"run.eval_only with run.resume=true but no '{eval_which}' "
+            f"checkpoint under {cfg.checkpoint_config().directory}"
         )
 
     if run.eval_only:
@@ -523,7 +541,9 @@ def train(cfg: TrainConfig) -> dict:
         if run.eval_only:
             # params/batch_stats/rng only — the saved opt_state never
             # touches the device (tx is a no-op identity here)
-            state, extra = ckpt.restore_eval(state, sharding=state_sharding)
+            state, extra = ckpt.restore_eval(
+                state, sharding=state_sharding, which=eval_which
+            )
         else:
             state, extra = ckpt.restore(state, sharding=state_sharding)
         start_step = int(state.step)

@@ -76,8 +76,11 @@ class RunConfig:
     sanity_eval: bool = True
     # evaluate-and-exit: restore weights (run.pretrained_ckpt or run.resume)
     # and run one full validation pass — no training. Beyond the reference
-    # (its eval only ever runs inline in the train loop).
+    # (its eval only ever runs inline in the train loop). eval_which picks
+    # the checkpoint slot restored under run.resume: the rolling "last"
+    # (resume semantics) or the metric-best "best".
     eval_only: bool = False
+    eval_which: str = "last"
     resume: bool = False
     pretrained_ckpt: str = ""
     profile_dir: str = ""

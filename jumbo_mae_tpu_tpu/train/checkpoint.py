@@ -234,8 +234,8 @@ class Checkpointer:
             )
         return is_best
 
-    def latest_step(self) -> int | None:
-        return self._last.latest_step()
+    def latest_step(self, which: str = "last") -> int | None:
+        return (self._last if which == "last" else self._best).latest_step()
 
     def _resolve(self, which: str, step: int | None):
         """(manager, concrete step) for ``which`` in {"last", "best"};

@@ -117,6 +117,94 @@ def test_eval_only_model_mismatch_raises(tmp_path):
         )
 
 
+@pytest.mark.slow
+def test_eval_only_which_best(tmp_path):
+    """run.eval_which=best restores the metric-BEST slot even when a later
+    (worse) 'last' checkpoint exists; a missing best slot and a config
+    where the knob would be silently dropped both raise."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    out = tmp_path / "run"
+    trained = train(load_config(RECIPES / "smoke_cpu.yaml", _smoke_overrides(out)))
+
+    # resume 2 more steps at an absurd LR: val loss blows up, so 'best'
+    # stays at step 4 while 'last' advances to step 6 — the two slots now
+    # hold DIFFERENT weights, making the assertions below discriminating
+    worse = train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml",
+            _smoke_overrides(
+                out,
+                [
+                    "run.resume=true",
+                    "run.training_steps=6",
+                    "run.eval_interval=6",
+                    "run.log_interval=6",
+                    "optim.learning_rate=100.0",
+                ],
+            ),
+        )
+    )
+    assert not worse["val/loss"] == pytest.approx(trained["val/loss"], rel=1e-4)
+
+    best = train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml",
+            _smoke_overrides(
+                out,
+                ["run.eval_only=true", "run.resume=true", "run.eval_which=best"],
+            ),
+        )
+    )
+    assert best["val/loss"] == pytest.approx(trained["val/loss"], rel=1e-6)
+
+    last = train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml",
+            _smoke_overrides(
+                out,
+                ["run.eval_only=true", "run.resume=true", "run.eval_which=last"],
+            ),
+        )
+    )
+    assert not last["val/loss"] == pytest.approx(best["val/loss"], rel=1e-4)
+
+    # an entirely absent best slot raises the slot-specific error (the
+    # last-present/best-absent split can't arise from the CLI: any eval
+    # that saves also promotes a first best)
+    with pytest.raises(FileNotFoundError, match="'best'"):
+        train(
+            load_config(
+                RECIPES / "smoke_cpu.yaml",
+                _smoke_overrides(
+                    tmp_path / "empty",
+                    [
+                        "run.eval_only=true",
+                        "run.resume=true",
+                        "run.eval_which=best",
+                    ],
+                ),
+            )
+        )
+
+    with pytest.raises(ValueError, match="eval_which"):
+        train(
+            load_config(
+                RECIPES / "smoke_cpu.yaml",
+                _smoke_overrides(out, ["run.eval_only=true", "run.eval_which=bogus"]),
+            )
+        )
+
+    # the knob must not be silently ignored outside eval_only+resume
+    with pytest.raises(ValueError, match="silently"):
+        train(
+            load_config(
+                RECIPES / "smoke_cpu.yaml",
+                _smoke_overrides(out, ["run.eval_which=best"]),
+            )
+        )
+
+
 def test_eval_only_resume_without_checkpoint_raises(tmp_path):
     """An explicit run.resume=true that can't be satisfied must raise, not
     silently evaluate a random init (regression)."""
@@ -129,7 +217,7 @@ def test_eval_only_resume_without_checkpoint_raises(tmp_path):
             ["run.eval_only=true", "run.resume=true"],
         ),
     )
-    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+    with pytest.raises(FileNotFoundError, match="no 'last' checkpoint"):
         train(cfg)
 
 
