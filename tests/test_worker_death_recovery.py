@@ -139,6 +139,25 @@ def test_worker_death_then_resume_is_bit_identical(tmp_path):
         stderr=subprocess.PIPE,
         text=True,
     )
+    # drain the pipes CONCURRENTLY with the watcher loop: the child's
+    # startup chatter (XLA cpu_aot_loader E-lines, one per cached program,
+    # ~3.5 KB each) can exceed the 64 KB pipe buffer, and an undrained
+    # pipe blocks the child mid-run — the watcher then waits forever for a
+    # checkpoint that can't be written
+    import threading
+
+    bufs: dict[str, list[str]] = {"out": [], "err": []}
+
+    def _drain(stream, key):
+        for line in stream:
+            bufs[key].append(line)
+
+    readers = [
+        threading.Thread(target=_drain, args=(proc.stdout, "out"), daemon=True),
+        threading.Thread(target=_drain, args=(proc.stderr, "err"), daemon=True),
+    ]
+    for t in readers:
+        t.start()
     ckpt_step3 = tmp_path / "b" / "smoke_cpu" / "ckpt" / "last" / "3"
     deadline = time.monotonic() + 300
     killed = None
@@ -152,11 +171,16 @@ def test_worker_death_then_resume_is_bit_identical(tmp_path):
                     break
             time.sleep(0.05)
         assert killed is not None, "never saw checkpoint step 3 + live workers"
-        out, err = proc.communicate(timeout=180)
+        proc.wait(timeout=180)
     finally:
         if proc.poll() is None:
             proc.kill()
-            proc.communicate()
+            proc.wait()
+        for t in readers:
+            t.join(timeout=30)
+        proc.stdout.close()
+        proc.stderr.close()
+    out, err = "".join(bufs["out"]), "".join(bufs["err"])
     assert proc.returncode != 0, f"run survived a dead worker: {out[-1500:]}"
     assert "deterministic stream lost" in err, err[-2000:]
 
