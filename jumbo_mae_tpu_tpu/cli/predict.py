@@ -1,0 +1,177 @@
+"""Serving entry point: run any model head over images from the CLI.
+
+The thin front end over ``jumbo_mae_tpu_tpu.infer`` — restore once, compile
+per bucket once, then stream requests:
+
+    # classification (finetune / linear-probe checkpoints)
+    python -m jumbo_mae_tpu_tpu.cli.predict --config recipes/finetune_vit_b16.yaml \
+        --ckpt runs/ft/ckpt --task logits --images cat.jpg dog.jpg --topk 5
+
+    # frozen-encoder features
+    python -m jumbo_mae_tpu_tpu.cli.predict --config recipes/linear_sgd_vit_b16.yaml \
+        --ckpt runs/pretrain/ckpt --task features --pool cls \
+        --images *.jpg --out feats.npz
+
+    # MAE reconstruction (pretrain checkpoints)
+    python -m jumbo_mae_tpu_tpu.cli.predict --config recipes/pretrain_vit_b16_in1k_1600ep.yaml \
+        --ckpt runs/pretrain/ckpt --task reconstruct --images cat.jpg --out recon.npz
+
+Files are resized + center-cropped by the eval transform (same geometry as
+validation). ``--serve`` additionally routes the requests through the
+micro-batching queue one image at a time — a single-process demo of the
+serving path (``--max-delay-ms``/``--max-batch`` are the coalescing knobs);
+the default path batches the whole file list directly. Results land in
+``--out`` (``.npz``) and, for ``logits``, as one JSON line per image on
+stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default=None, help="YAML recipe path")
+    p.add_argument(
+        "--ckpt",
+        default="",
+        help="Orbax run/checkpoint dir or .msgpack params; random init if omitted",
+    )
+    p.add_argument(
+        "--task", choices=("features", "logits", "reconstruct"), default="logits"
+    )
+    p.add_argument(
+        "--images", nargs="+", default=[], metavar="FILE", help="image files"
+    )
+    p.add_argument(
+        "--synthetic",
+        type=int,
+        default=0,
+        metavar="N",
+        help="use N synthetic images instead of --images (smoke/bench)",
+    )
+    p.add_argument("--out", default="", help="output .npz path")
+    p.add_argument("--pool", choices=("cls", "gap", "tokens"), default="cls")
+    p.add_argument("--topk", type=int, default=5, help="logits: classes per line")
+    p.add_argument("--seed", type=int, default=0, help="reconstruct: mask seed")
+    p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument(
+        "--max-delay-ms", type=float, default=5.0, help="--serve coalescing deadline"
+    )
+    p.add_argument(
+        "--serve",
+        action="store_true",
+        help="submit images one-by-one through the micro-batching queue",
+    )
+    p.add_argument(
+        "--dtype",
+        default=None,
+        help="serving compute dtype override (e.g. float32 for the exact path)",
+    )
+    p.add_argument(
+        "--set",
+        dest="overrides",
+        metavar="KEY.PATH=VALUE",
+        nargs="*",
+        action="extend",
+        default=[],
+        help="dotted config overrides, same grammar as cli.train",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> Path | None:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.infer import InferenceEngine, MicroBatcher
+
+    if jax.process_count() > 1:
+        raise SystemExit("predict is a single-process tool; run it on one host")
+    if bool(args.images) == bool(args.synthetic):
+        raise SystemExit("pass exactly one of --images or --synthetic N")
+
+    cfg = load_config(args.config, args.overrides)
+    engine = InferenceEngine(
+        cfg, ckpt=args.ckpt, dtype=args.dtype, max_batch=args.max_batch
+    )
+    if args.ckpt == "":
+        print("[predict] WARNING: no --ckpt — serving a random init")
+
+    size = engine.image_size
+    if args.synthetic:
+        images = (
+            np.random.RandomState(0)
+            .randint(0, 256, (args.synthetic, size, size, 3))
+            .astype(np.uint8)
+        )
+        names = [f"synthetic[{i}]" for i in range(args.synthetic)]
+    else:
+        from PIL import Image
+
+        from jumbo_mae_tpu_tpu.data.transforms import eval_transform
+
+        images = np.stack(
+            [
+                eval_transform(
+                    np.asarray(Image.open(f).convert("RGB"), np.uint8),
+                    size,
+                    crop_ratio=cfg.data.test_crop_ratio,
+                )
+                for f in args.images
+            ]
+        )
+        names = list(args.images)
+
+    kw = {"pool": args.pool} if args.task == "features" else (
+        {"seed": args.seed} if args.task == "reconstruct" else {}
+    )
+    if args.serve:
+        with MicroBatcher(
+            lambda batch: engine.predict(batch, task=args.task, **kw),
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+        ) as mb:
+            rows = [f.result() for f in [mb.submit(img) for img in images]]
+        out = (
+            {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+            if isinstance(rows[0], dict)
+            else np.stack(rows)
+        )
+        print(f"[predict] micro-batch sizes: {mb.batch_sizes}")
+    else:
+        out = engine.predict(images, task=args.task, **kw)
+
+    if args.task == "logits":
+        probs = np.exp(out - out.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        k = min(args.topk, out.shape[-1])
+        for name, p_row in zip(names, probs):
+            top = np.argsort(-p_row)[:k]
+            print(
+                json.dumps(
+                    {
+                        "image": name,
+                        "classes": top.tolist(),
+                        "probs": [round(float(p_row[i]), 6) for i in top],
+                    }
+                )
+            )
+    payload = out if isinstance(out, dict) else {args.task: out}
+    if not args.out:
+        return None
+    path = Path(args.out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(path, **payload)
+    print(f"[predict] wrote {args.task} for {len(names)} image(s) -> {path}")
+    return path
+
+
+if __name__ == "__main__":
+    main()

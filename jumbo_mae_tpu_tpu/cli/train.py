@@ -355,6 +355,7 @@ def evaluate(eval_step, state, batches, pad_batch: dict | None = None) -> dict[s
     totals: dict[str, float] = {}
     it = iter(batches)
     i = 0
+    pending: list = []
     while True:
         batch = next(it, None)
         if multi:
@@ -373,8 +374,12 @@ def evaluate(eval_step, state, batches, pad_batch: dict | None = None) -> dict[s
                 batch = pad_batch
         elif batch is None:
             break
-        sums = jax.device_get(eval_step(state, batch, i))
+        # accumulate device scalars; fetch ONCE after the loop — a per-batch
+        # device_get would serialize host dispatch against device compute,
+        # exactly what the train loop avoids at its log boundaries
+        pending.append(eval_step(state, batch, i))
         i += 1
+    for sums in jax.device_get(pending):
         for k, v in sums.items():
             totals[k] = totals.get(k, 0.0) + float(v)
     n = max(totals.pop("num_samples", 0.0), 1.0)

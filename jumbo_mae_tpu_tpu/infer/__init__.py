@@ -1,0 +1,4 @@
+from jumbo_mae_tpu_tpu.infer.batching import MicroBatcher
+from jumbo_mae_tpu_tpu.infer.engine import InferenceEngine, bucket_for
+
+__all__ = ["InferenceEngine", "MicroBatcher", "bucket_for"]

@@ -1,0 +1,134 @@
+"""Micro-batching queue: coalesce concurrent requests into MXU-size batches.
+
+Serving traffic arrives as single images; the MXU (and even XLA:CPU's
+dispatch overhead) wants batches. The :class:`MicroBatcher` sits between
+them: callers ``submit()`` one image and get a future; a collector thread
+drains the queue into a batch, waiting at most ``max_delay_ms`` from the
+first queued request (the latency the operator is willing to trade for
+throughput) and never exceeding ``max_batch`` (the engine's largest
+bucket), then runs the whole batch through ``run_fn`` once and routes row
+``i`` of the result back to request ``i``.
+
+Ordering is a contract, not an accident: the queue is FIFO, a batch is the
+next ``k`` requests in arrival order, and results are assigned by row
+index — so responses can never cross between concurrent callers (pinned by
+``tests/test_infer_engine.py`` under a thread storm). A ``run_fn`` failure
+fails exactly the requests in that batch; later batches proceed.
+
+The batcher is engine-agnostic — ``run_fn`` is any callable mapping a
+stacked ``(k, ...)`` array to an array (or dict of arrays) with leading
+dimension ``k`` — so tests drive it with plain numpy and the serving path
+drives it with :meth:`InferenceEngine.features` et al.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable
+
+import numpy as np
+
+_STOP = object()
+
+
+class MicroBatcher:
+    """Thread-safe request coalescer in front of a batched ``run_fn``.
+
+    ``max_delay_ms`` bounds the extra latency any request can pay waiting
+    for co-travelers; ``max_batch`` bounds the batch handed to ``run_fn``.
+    ``batch_sizes`` records every flushed batch's size (bench/test
+    observability). Use as a context manager or call :meth:`close`.
+    """
+
+    def __init__(
+        self,
+        run_fn: Callable[[np.ndarray], Any],
+        *,
+        max_batch: int = 32,
+        max_delay_ms: float = 5.0,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.run_fn = run_fn
+        self.max_batch = int(max_batch)
+        self.max_delay = float(max_delay_ms) / 1000.0
+        self.batch_sizes: list[int] = []
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="microbatcher"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- client
+
+    def submit(self, image: np.ndarray) -> Future:
+        """Enqueue one request (a single image, no batch dim); returns a
+        future resolving to that request's row of the batched result."""
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        fut: Future = Future()
+        self._q.put((np.asarray(image), fut))
+        return fut
+
+    def __call__(self, image: np.ndarray):
+        """Blocking convenience: submit and wait."""
+        return self.submit(image).result()
+
+    def close(self):
+        """Flush pending requests and stop the collector thread."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(_STOP)
+            self._thread.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---------------------------------------------------------- collector
+
+    def _loop(self):
+        import time
+
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            batch = [item]
+            deadline = time.monotonic() + self.max_delay
+            stop = False
+            while len(batch) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                batch.append(nxt)
+            self._flush(batch)
+            if stop:
+                return
+
+    def _flush(self, batch):
+        self.batch_sizes.append(len(batch))
+        try:
+            out = self.run_fn(np.stack([img for img, _ in batch]))
+        except BaseException as e:  # noqa: BLE001 — route to the waiters
+            for _, fut in batch:
+                fut.set_exception(e)
+            return
+        if isinstance(out, dict):
+            for i, (_, fut) in enumerate(batch):
+                fut.set_result({k: v[i] for k, v in out.items()})
+        else:
+            for (_, fut), row in zip(batch, out):
+                fut.set_result(row)

@@ -1,0 +1,430 @@
+"""The batched inference engine: shape-bucketed AOT executables.
+
+Training drove the per-step roofline (PERF.md); this module is the serving
+counterpart. The design moves every once-per-model cost out of the request
+path:
+
+- **Restore once.** The checkpoint is read a single time per process via
+  :func:`~jumbo_mae_tpu_tpu.train.checkpoint.restore_inference_state`
+  (params + BatchNorm stats only — the optimizer state's ~2x-params bytes
+  are never read), then merged onto each task's serving module with the
+  same overlap diagnostics the warm-start path prints.
+- **Compile once per (task, bucket).** Request batches are padded up to a
+  power-of-two bucket and run through an explicitly cached executable,
+  lowered ahead-of-time with ``jax.jit(...).lower().compile()`` — the hot
+  path never enters the jit tracing/cache machinery, and a compile can
+  only happen where :meth:`InferenceEngine.warmup` or the first miss puts
+  it. ``compile_counts`` / ``on_compile`` expose exactly when that was.
+  The persistent compile cache (``JAX_COMPILATION_CACHE_DIR``, claimed
+  crash-safe by ``utils/procenv.enable_compile_cache``) warm-starts the
+  buckets across processes.
+- **Padding is provably inert.** Every model op is row-independent in
+  deterministic mode (per-token norms, within-sample attention, stored
+  BatchNorm stats), so a padded row cannot perturb a valid row — the same
+  ``valid``-mask convention the eval step uses, enforced bit-exactly by
+  ``tests/test_infer_engine.py`` on the float32 path. The engine slices
+  the valid rows out on the host; callers never see padding.
+
+Three tasks cover the model zoo's heads:
+
+- ``features`` — frozen-encoder embeddings (``pool`` ∈ cls/gap/tokens),
+  the representation ``tools/extract_features.py`` / the kNN probe serve;
+- ``logits``  — classification logits through the trained head
+  (finetune or linear-probe checkpoints, BatchNorm stats grafted);
+- ``reconstruct`` — MAE pixel reconstruction + mask (the demo-figure
+  path), mask seed passed as a traced scalar so reseeding never recompiles.
+
+Single-process by design: serving replicas scale horizontally; the mesh
+machinery stays in the training stack.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jumbo_mae_tpu_tpu.config import TrainConfig
+from jumbo_mae_tpu_tpu.models import (
+    DecoderConfig,
+    JumboViT,
+    MAEPretrainModel,
+    pool_tokens,
+    preset,
+)
+from jumbo_mae_tpu_tpu.ops.preprocess import normalize_images
+from jumbo_mae_tpu_tpu.train.checkpoint import (
+    _ENCODER_KEYS,
+    merge_pretrained_params,
+    require_loaded,
+    restore_inference_state,
+)
+from jumbo_mae_tpu_tpu.utils.procenv import enable_compile_cache
+
+POOLS = ("cls", "gap", "tokens")
+
+
+def bucket_for(n: int, max_batch: int) -> int:
+    """Smallest power-of-two >= n, clamped to ``max_batch`` (so the number
+    of distinct compiled programs is log2(max_batch)+1, not one per
+    request size)."""
+    if n <= 0:
+        raise ValueError(f"need a positive batch, got {n}")
+    if n >= max_batch:
+        return max_batch
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _to_state_dict(tree) -> dict:
+    from flax import serialization
+
+    return serialization.to_state_dict(tree)
+
+
+class InferenceEngine:
+    """Restore a checkpoint once; serve bucket-batched forwards forever.
+
+    ``cfg`` is the training recipe (`TrainConfig`) whose model section
+    defines the encoder/decoder; ``ckpt`` any
+    :func:`restore_inference_state` carrier (omit for random init —
+    benchmarking only, a loaded checkpoint is enforced through the same
+    ``require_loaded`` guard the export tools use).
+
+    ``dtype`` overrides the serving compute dtype (default: the recipe's
+    encoder dtype — bf16 on the chip; pass ``"float32"`` for the exact
+    path). ``max_batch`` caps the largest bucket; requests larger than it
+    are chunked. All public predict methods are thread-safe (compiles are
+    serialized behind a lock; dispatches run concurrently).
+    """
+
+    def __init__(
+        self,
+        cfg: TrainConfig,
+        *,
+        ckpt: str = "",
+        dtype: str | None = None,
+        max_batch: int = 64,
+        labels: int | None = None,
+        batch_norm: bool | None = None,
+        on_compile: Callable[[str, int], None] | None = None,
+        compile_cache: str | None = None,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        enable_compile_cache(compile_cache)
+        self.cfg = cfg
+        self.max_batch = int(max_batch)
+        self.on_compile = on_compile
+        m = cfg.model
+        overrides = dict(m.overrides)
+        if dtype is not None:
+            overrides["dtype"] = dtype
+        # serving is always deterministic — stochastic knobs forced off,
+        # LAST, so recipe overrides can't re-enable them
+        self._enc = preset(
+            m.preset,
+            **{
+                **overrides,
+                "labels": None,
+                "mask_ratio": None,
+                "dropout": 0.0,
+                "droppath": 0.0,
+            },
+        )
+        self._labels = labels if labels is not None else overrides.get("labels")
+        self._batch_norm = (
+            batch_norm if batch_norm is not None else cfg.run.mode == "linear"
+        )
+        self._dec = DecoderConfig(
+            **{
+                "layers": m.dec_layers,
+                "dim": m.dec_dim,
+                "heads": m.dec_heads,
+                "dtype": m.dec_overrides.get("dtype", m.dec_dtype)
+                if dtype is None
+                else dtype,
+                **{
+                    k: v
+                    for k, v in m.dec_overrides.items()
+                    if k not in ("dtype", "dropout", "droppath")
+                },
+            }
+        )
+        self.image_size = self._enc.image_size
+
+        self._ckpt = str(ckpt)
+        self._ckpt_tree: dict | None = None
+        self._ckpt_stats: dict | None = None
+        if self._ckpt:
+            tree, stats = restore_inference_state(self._ckpt)
+            self._ckpt_tree = _to_state_dict(tree)
+            self._ckpt_stats = (
+                _to_state_dict(stats) if stats is not None else None
+            )
+
+        self.load_stats: dict[str, dict] = {}
+        self._tasks: dict[str, dict] = {}  # task -> {model, params, ...}
+        self._exec: dict[tuple[str, int], Any] = {}
+        self.compile_counts: dict[tuple[str, int], int] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------------- tasks
+
+    def _graft(self, task: str, init_params, *, subtree: str, whole: bool):
+        """Merge the restored checkpoint tree onto a task's fresh init.
+        ``whole=True`` merges the full tree (reconstruct needs the decoder);
+        otherwise the checkpoint's encoder subtree (``encoder`` for
+        pretrain trees, ``model`` for classification trees, else the bare
+        root) lands on ``subtree`` of the init."""
+        if self._ckpt_tree is None:
+            return init_params
+        from flax import serialization
+
+        init_sd = _to_state_dict(init_params)
+        stats: dict = {}
+        if whole:
+            merged = merge_pretrained_params(
+                self._ckpt_tree, init_sd, stats=stats
+            )
+        else:
+            src_key = next(
+                (k for k in _ENCODER_KEYS if k in self._ckpt_tree), None
+            )
+            src = self._ckpt_tree[src_key] if src_key else self._ckpt_tree
+            dst = init_sd[subtree] if subtree else init_sd
+            sub_merged = merge_pretrained_params(src, dst, stats=stats)
+            merged = (
+                {**init_sd, subtree: sub_merged} if subtree else sub_merged
+            )
+        require_loaded(stats, self._ckpt, f"the {task} serving model")
+        self.load_stats[task] = stats
+        return serialization.from_state_dict(init_params, merged)
+
+    def _build_task(self, task: str) -> dict:
+        size = self.image_size
+        example = jnp.zeros((1, size, size, 3), jnp.uint8)
+        rngs = {"params": jax.random.key(self.cfg.run.init_seed)}
+        if task == "features":
+            model = JumboViT(self._enc)
+            variables = model.init(
+                rngs, normalize_images(example, dtype=self._enc.compute_dtype), True
+            )
+            params = self._graft(task, variables["params"], subtree="", whole=False)
+            return {"model": model, "params": params, "batch_stats": None}
+        if task == "logits":
+            if not self._labels:
+                raise ValueError(
+                    "the logits task needs a label count — set "
+                    "model.overrides.labels in the recipe or pass labels="
+                )
+            enc = self._enc.replace(
+                labels=int(self._labels), batch_norm=self._batch_norm
+            )
+            model = JumboViT(enc)
+            variables = model.init(
+                rngs, normalize_images(example, dtype=enc.compute_dtype), True
+            )
+            params = self._graft(task, variables["params"], subtree="", whole=False)
+            batch_stats = variables.get("batch_stats")
+            if batch_stats is not None and self._ckpt_stats is not None:
+                from flax import serialization
+
+                saved = self._ckpt_stats
+                # classification trees keep the head's stats under "model"
+                saved = saved.get("model", saved)
+                batch_stats = serialization.from_state_dict(batch_stats, saved)
+            return {"model": model, "params": params, "batch_stats": batch_stats}
+        if task == "reconstruct":
+            enc = self._enc.replace(
+                mask_ratio=self.cfg.model.overrides.get("mask_ratio", 0.75)
+            )
+            model = MAEPretrainModel(
+                enc, self._dec, norm_pix_loss=self.cfg.model.norm_pix_loss
+            )
+            variables = model.init(
+                {**rngs, "noise": jax.random.key(0)}, example
+            )
+            params = self._graft(task, variables["params"], subtree="", whole=True)
+            return {"model": model, "params": params, "batch_stats": None}
+        raise ValueError(f"unknown task {task!r}")
+
+    def _task(self, task: str) -> dict:
+        t = self._tasks.get(task)
+        if t is None:
+            with self._lock:
+                t = self._tasks.get(task)
+                if t is None:
+                    t = self._build_task(task)
+                    self._tasks[task] = t
+        return t
+
+    # ---------------------------------------------------- executable cache
+
+    def _task_key(self, task: str, pool: str | None) -> str:
+        return f"{task}:{pool}" if pool else task
+
+    def _fn(self, task: str, pool: str | None):
+        t = self._task(task)
+        model, batch_stats = t["model"], t["batch_stats"]
+        if task == "features":
+            k = self._enc.num_cls_tokens
+
+            def fn(params, images):
+                x = normalize_images(images, dtype=self._enc.compute_dtype)
+                tokens = model.apply({"params": params}, x, True)
+                out = (
+                    tokens if pool == "tokens" else pool_tokens(tokens, k, pool)
+                )
+                return out.astype(jnp.float32)
+
+            return fn
+        if task == "logits":
+
+            def fn(params, images):
+                variables = {"params": params}
+                if batch_stats is not None:
+                    variables["batch_stats"] = batch_stats
+                x = normalize_images(images, dtype=self._enc.compute_dtype)
+                return model.apply(variables, x, True).astype(jnp.float32)
+
+            return fn
+
+        def fn(params, images, seed):
+            out = model.apply(
+                {"params": params},
+                images,
+                True,
+                True,
+                rngs={"noise": jax.random.key(seed)},
+            )
+            return {
+                "reconstruction": out["reconstruction"].astype(jnp.float32),
+                "mask": out["mask"].astype(jnp.float32),
+            }
+
+        return fn
+
+    def _executable(self, task: str, pool: str | None, bucket: int):
+        key = (self._task_key(task, pool), bucket)
+        ex = self._exec.get(key)
+        if ex is not None:
+            return ex
+        with self._lock:
+            ex = self._exec.get(key)
+            if ex is not None:
+                return ex
+            t = self._task(task)
+            size = self.image_size
+            images = jax.ShapeDtypeStruct((bucket, size, size, 3), jnp.uint8)
+            # donate the request buffer: its HBM is recycled for
+            # intermediates the moment normalize reads it (no-op on CPU,
+            # where jax would warn per program)
+            donate = (1,) if jax.default_backend() != "cpu" else ()
+            args = [t["params"], images]
+            if task == "reconstruct":
+                args.append(jax.ShapeDtypeStruct((), jnp.int32))
+            ex = (
+                jax.jit(self._fn(task, pool), donate_argnums=donate)
+                .lower(*args)
+                .compile()
+            )
+            self._exec[key] = ex
+            self.compile_counts[key] = self.compile_counts.get(key, 0) + 1
+            if self.on_compile is not None:
+                self.on_compile(key[0], bucket)
+            return ex
+
+    def warmup(
+        self,
+        tasks: tuple[str, ...] = ("features",),
+        *,
+        pool: str = "cls",
+        buckets: tuple[int, ...] | None = None,
+    ) -> int:
+        """Pre-compile every (task, bucket) executable the workload will
+        hit — afterwards the request path never compiles (asserted by the
+        bench's zero-recompiles-after-warmup report). Default buckets:
+        every power of two up to ``max_batch``."""
+        if buckets is None:
+            buckets = tuple(
+                b for b in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+                if b <= self.max_batch
+            )
+        n = 0
+        for task in tasks:
+            p = pool if task == "features" else None
+            for b in buckets:
+                before = self.compile_counts.get((self._task_key(task, p), b), 0)
+                self._executable(task, p, b)
+                n += self.compile_counts[(self._task_key(task, p), b)] - before
+        return n
+
+    # -------------------------------------------------------------- predict
+
+    def _run(self, task: str, pool: str | None, images: np.ndarray, extra=()):
+        """Bucket-pad one chunk (len <= max_batch), run, slice valid rows."""
+        n = images.shape[0]
+        bucket = bucket_for(n, self.max_batch)
+        if n < bucket:
+            pad = np.zeros((bucket - n, *images.shape[1:]), images.dtype)
+            images = np.concatenate([images, pad])
+        t = self._task(task)
+        out = self._executable(task, pool, bucket)(t["params"], images, *extra)
+        return jax.tree_util.tree_map(lambda a: np.asarray(a)[:n], out)
+
+    def _predict(self, task: str, images, *, pool=None, extra=()):
+        images = np.asarray(images)
+        if images.ndim == 3:
+            images = images[None]
+        if images.ndim != 4 or images.shape[-1] != 3:
+            raise ValueError(f"expected (n, H, W, 3) uint8 images, got {images.shape}")
+        if images.shape[1] != self.image_size or images.shape[2] != self.image_size:
+            raise ValueError(
+                f"engine is compiled for {self.image_size}px inputs, got "
+                f"{images.shape[1]}x{images.shape[2]} — resize upstream"
+            )
+        images = images.astype(np.uint8, copy=False)
+        chunks = [
+            self._run(task, pool, images[i : i + self.max_batch], extra)
+            for i in range(0, images.shape[0], self.max_batch)
+        ]
+        if len(chunks) == 1:
+            return chunks[0]
+        return jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs), *chunks
+        )
+
+    def features(self, images, *, pool: str = "cls") -> np.ndarray:
+        """Pooled (or full-token) float32 encoder features, one row per
+        input image."""
+        if pool not in POOLS:
+            raise ValueError(f"pool must be one of {POOLS}, got {pool!r}")
+        return self._predict("features", images, pool=pool)
+
+    def logits(self, images) -> np.ndarray:
+        """Float32 classification logits through the trained head."""
+        return self._predict("logits", images)
+
+    def reconstruct(self, images, *, seed: int = 0) -> dict[str, np.ndarray]:
+        """MAE reconstruction: ``{"reconstruction": (n, N, p*p*3), "mask":
+        (n, N)}`` in (possibly norm-pix) patch space — same contract as
+        ``tools/reconstruct.py``. ``seed`` varies the mask without
+        recompiling (traced scalar)."""
+        return self._predict(
+            "reconstruct", images, extra=(jnp.asarray(seed, jnp.int32),)
+        )
+
+    def predict(self, images, task: str = "features", **kw):
+        if task == "features":
+            return self.features(images, **kw)
+        if task == "logits":
+            return self.logits(images, **kw)
+        if task == "reconstruct":
+            return self.reconstruct(images, **kw)
+        raise ValueError(f"unknown task {task!r}")

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from jumbo_mae_tpu_tpu.utils import compat
+
 NEG_INF = -1e30
 
 
@@ -145,7 +147,7 @@ def _ring_attention_flash(
         pallas_flash_attention_with_lse,
     )
 
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     bq, sq, h, d = q.shape
 
@@ -206,7 +208,7 @@ def ring_self_attention(
 ) -> jax.Array:
     """Sequence-parallel self-attention, for use inside model code under
     ``jit``. Uses the *ambient* mesh by default (activate with
-    ``jax.sharding.set_mesh``) or an explicitly passed ``mesh``. Handles
+    ``utils.compat.set_mesh``) or an explicitly passed ``mesh``. Handles
     sequence lengths that don't divide the ``seq`` axis by zero-padding K/V
     and masking the pad keys (the mask ring-rotates with its block). Falls
     back to plain attention when no mesh is active or its ``seq`` axis is
@@ -214,7 +216,7 @@ def ring_self_attention(
 
     q, k, v: (batch, seq, heads, head_dim), queries pre-scaled.
     """
-    shape = (mesh or jax.sharding.get_abstract_mesh()).shape
+    shape = (mesh or compat.ambient_mesh()).shape
     n = shape.get(seq_axis, 1)
     if not n or n <= 1:
         from jumbo_mae_tpu_tpu.ops.flash_attention import xla_attention
@@ -227,7 +229,7 @@ def ring_self_attention(
     bspec = tuple(a for a in batch_axes if shape.get(a, 1) > 1) or None
     qkv_spec = P(bspec, seq_axis, None, None)
     if not pad:
-        return jax.shard_map(
+        return compat.shard_map(
             partial(
                 ring_attention,
                 axis_name=seq_axis,
@@ -248,7 +250,7 @@ def ring_self_attention(
     widths = ((0, 0), (0, pad), (0, 0), (0, 0))
     q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
     kv_mask = jnp.broadcast_to(jnp.arange(s_pad) < s, (b, s_pad))
-    out = jax.shard_map(
+    out = compat.shard_map(
         partial(ring_attention, axis_name=seq_axis),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, P(bspec, seq_axis)),

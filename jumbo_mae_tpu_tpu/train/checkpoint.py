@@ -339,9 +339,7 @@ class Checkpointer:
             out = mgr.restore(
                 step,
                 args=ocp.args.Composite(
-                    state=ocp.args.PyTreeRestore(
-                        item=item, partial_restore=True
-                    ),
+                    state=_partial_pytree_restore(item),
                     extra=ocp.args.JsonRestore(),
                 ),
             )
@@ -422,6 +420,26 @@ class Checkpointer:
         self.wait()
         self._last.close()
         self._best.close()
+
+
+def _partial_pytree_restore(item) -> "ocp.args.PyTreeRestore":
+    """Version-portable partial ``PyTreeRestore``. ``item`` is a tree with
+    ``RestoreArgs`` leaves naming exactly the paths to read; checkpoint
+    paths outside it are never touched, and item paths ABSENT from the
+    checkpoint come back as the ``RestoreArgs`` leaves themselves (the
+    callers' mismatch detection keys on that). Newer orbax spells this
+    ``partial_restore=True``; 0.7.x spells it ``restore_args`` + a non-None
+    ``transforms`` (the RestoreArgs leaves double as their own structure
+    placeholders — verified semantics-identical, incl. the missing-path
+    behavior). The seed pinned the newer spelling only, which is why every
+    ``restore_eval`` path failed under the installed 0.7.0 (seed-test
+    triage, round 6)."""
+    import inspect
+
+    params = inspect.signature(ocp.args.PyTreeRestore.__init__).parameters
+    if "partial_restore" in params:
+        return ocp.args.PyTreeRestore(item=item, partial_restore=True)
+    return ocp.args.PyTreeRestore(item=item, restore_args=item, transforms={})
 
 
 def _leaf_dtype_map(tree) -> dict[str, Any]:
@@ -674,11 +692,12 @@ def load_pretrained_params(
     return serialization.from_state_dict(init_params, merged)
 
 
-def _restore_params_only(mgr, step) -> dict | None:
-    """Partial restore of the ``params`` subtree alone — the optimizer
-    state's ~2x-params bytes are never read. Needs the saved tree's
-    structure, taken from the checkpoint metadata; returns None when the
-    layout doesn't expose it (caller falls back to a whole-tree restore)."""
+def _restore_subtrees(mgr, step, names: tuple[str, ...]) -> dict | None:
+    """Partial restore of the named top-level state subtrees — everything
+    else (the optimizer state's ~2x-params bytes above all) is never read.
+    Needs the saved tree's structure, taken from the checkpoint metadata;
+    returns None when the layout doesn't expose it or ``params`` is absent
+    (caller falls back to a whole-tree restore)."""
     try:
         meta = mgr.item_metadata(step)
         state_meta = None if meta is None else meta.get("state")
@@ -686,20 +705,65 @@ def _restore_params_only(mgr, step) -> dict | None:
         if not isinstance(tree, dict) or "params" not in tree:
             return None
         item = {
-            "params": jax.tree_util.tree_map(
+            name: jax.tree_util.tree_map(
                 lambda _: ocp.RestoreArgs(restore_type=np.ndarray),
-                tree["params"],
+                tree[name],
             )
+            for name in names
+            if isinstance(tree.get(name), dict)
         }
         out = mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.PyTreeRestore(item=item, partial_restore=True)
-            ),
+            step, args=ocp.args.Composite(state=_partial_pytree_restore(item))
         )
-        return out["state"]["params"]
+        return out["state"]
     except Exception:
         return None
+
+
+def _restore_params_only(mgr, step) -> dict | None:
+    out = _restore_subtrees(mgr, step, ("params",))
+    return None if out is None else out.get("params")
+
+
+def restore_inference_state(path) -> tuple[dict, dict | None]:
+    """Restore ``(params, batch_stats)`` for serving — the checkpoint's
+    optimizer-state bytes are never read or staged (same partial-restore
+    machinery as :meth:`Checkpointer.restore_eval`, without needing a live
+    TrainState template). ``batch_stats`` is None when the checkpoint has
+    none (pretrain/finetune trees; linear-probe trees carry the probe
+    head's BatchNorm statistics, which deterministic serving needs).
+
+    ``path`` accepts every :func:`load_params_tree` carrier: a Checkpointer
+    run directory (``best``/``last`` layout, local or ``gs://``), a direct
+    manager dir, a ``.msgpack`` params file, or a stream URL — the stream
+    forms carry params only."""
+    s = str(path)
+    if s.startswith(("pipe:", "http://", "https://")) or (
+        is_remote_path(s) and s.endswith(".msgpack")
+    ):
+        return import_params_msgpack(s), None
+    p = checkpoint_root(s)
+    if not p.is_dir():
+        return import_params_msgpack(s), None
+    for sub in ("best", "last", "."):
+        root = p if sub == "." else p / sub
+        if not root.is_dir():
+            continue
+        with ocp.CheckpointManager(
+            root,
+            item_handlers={
+                "state": ocp.PyTreeCheckpointHandler(),
+                "extra": ocp.JsonCheckpointHandler(),
+            },
+        ) as mgr:
+            step = mgr.latest_step()
+            if step is None:
+                continue
+            out = _restore_subtrees(mgr, step, ("params", "batch_stats"))
+            if out is not None and out.get("params") is not None:
+                return out["params"], out.get("batch_stats")
+    # legacy layouts without usable metadata: whole-tree restore
+    return restore_params_any(p), None
 
 
 def restore_params_any(directory) -> dict:

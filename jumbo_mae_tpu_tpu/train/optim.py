@@ -25,6 +25,8 @@ from typing import Any, Literal, NamedTuple
 import jax
 import jax.numpy as jnp
 import optax
+
+from jumbo_mae_tpu_tpu.utils import compat
 from jax.tree_util import tree_map_with_path
 
 OptimizerName = Literal["adamw", "lamb", "lars", "sgd"]
@@ -130,7 +132,7 @@ def scale_by_adam_dtyped(
 
     def update_fn(updates, state, params=None):
         del params
-        count = optax.safe_increment(state.count)
+        count = compat.safe_increment(state.count)
         f32 = jnp.float32
         mu_f = jax.tree.map(
             lambda g, m: b1 * m.astype(f32) + (1 - b1) * g.astype(f32),

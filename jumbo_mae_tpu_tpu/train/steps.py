@@ -88,6 +88,11 @@ def create_sharded_state(
     half weight-read HBM traffic); pair it with ``optim.param_dtype`` so the
     optimizer keeps a float32 master copy (``with_master_weights``).
     """
+    from jumbo_mae_tpu_tpu.utils.compat import ensure_partitionable_rng
+
+    # init draws must not depend on the mesh layout (jax 0.4.x defaults
+    # non-partitionable threefry, where they do)
+    ensure_partitionable_rng()
     inputs = _model_inputs(mode, example_batch)
     init_rngs = {
         "params": jax.random.key(init_seed),

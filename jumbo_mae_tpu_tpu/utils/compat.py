@@ -1,0 +1,93 @@
+"""Version-portability shims for the jax/optax surface this framework uses.
+
+The code targets the current jax API (``jax.shard_map`` with ``check_vma``,
+``jax.sharding.set_mesh`` / ``get_abstract_mesh``, ``optax.safe_increment``);
+deployment containers routinely ship one major step behind (jax 0.4.x /
+older optax), where the same capabilities live under older names
+(``jax.experimental.shard_map`` with ``check_rep``, the ``Mesh`` context
+manager, ``optax.safe_int32_increment``). Every call site routes through
+here so the framework runs unchanged on both — the round-6 seed triage
+traced a third of the tier-1 failures to exactly these renames.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def ambient_mesh():
+    """The active ambient mesh: ``jax.sharding.get_abstract_mesh()`` where
+    it exists, the thread-resource physical mesh on 0.4.x. Both expose the
+    ``.shape`` mapping the callers use; both return an empty-shape mesh
+    when none is active."""
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return jax.sharding.get_abstract_mesh()
+    from jax._src import mesh as mesh_lib
+
+    return mesh_lib.thread_resources.env.physical_mesh
+
+
+def set_mesh(mesh):
+    """Context manager activating ``mesh`` as the ambient mesh:
+    ``jax.sharding.set_mesh`` where it exists; on 0.4.x a ``Mesh`` is its
+    own context manager."""
+    if hasattr(jax.sharding, "set_mesh"):
+        return jax.sharding.set_mesh(mesh)
+    return mesh
+
+
+def shard_map(fn, *, mesh=None, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` where it exists; ``jax.experimental.shard_map``
+    on 0.4.x (``check_vma`` maps onto its ``check_rep``, and the ambient
+    mesh is resolved explicitly because the experimental version requires
+    one)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if mesh is None:
+        mesh = ambient_mesh()
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis: ``jax.lax.axis_size`` where it
+    exists, the core axis frame on 0.4.x."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax.core import axis_frame
+
+    frame = axis_frame(axis_name)
+    # 0.4.x returns the size directly; earlier still, a frame object
+    return getattr(frame, "size", frame)
+
+
+def ensure_partitionable_rng():
+    """Make random draws independent of the output sharding. Newer jax
+    defaults ``jax_threefry_partitionable=True``; 0.4.x defaults False,
+    where a ``jit(init, out_shardings=...)`` program can generate DIFFERENT
+    values for a sharded array than the unsharded program would (observed:
+    one fsdp-sharded kernel at init drew a wholly different tensor,
+    breaking sharded-equals-single-device). The partitionable lowering
+    computes the same function under every layout — flip it on once."""
+    try:
+        if not jax.config.jax_threefry_partitionable:
+            jax.config.update("jax_threefry_partitionable", True)
+    except AttributeError:  # the flag is gone once new jax drops it
+        pass
+
+
+def safe_increment(count):
+    """``optax.safe_increment``, née ``safe_int32_increment``."""
+    import optax
+
+    fn = getattr(optax, "safe_increment", None)
+    if fn is None:
+        fn = optax.safe_int32_increment
+    return fn(count)

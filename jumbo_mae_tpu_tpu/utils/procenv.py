@@ -15,7 +15,9 @@ whether the real accelerator answers.)
 
 from __future__ import annotations
 
+import atexit
 import os
+from pathlib import Path
 
 
 def host_fingerprint() -> str:
@@ -56,6 +58,74 @@ def host_cache_dir(repo_root: str | os.PathLike) -> str:
     return os.path.join(
         str(repo_root), ".jax_cache", f"host-{host_fingerprint()}"
     )
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def claim_compile_cache(cache_dir: str | os.PathLike) -> str:
+    """Crash-safe claim of a persistent-compile-cache directory.
+
+    jax's on-disk cache writes entries non-atomically (``LRUCache.put`` is a
+    plain ``write_bytes``) and never overwrites an existing key — so a
+    process killed mid-write (the tier-1 gate's own ``timeout -k``, a
+    preempted pod) leaves a *permanently* truncated serialized executable,
+    and XLA:CPU aborts the whole process deserializing it on every later
+    run. Protocol: each process using the cache drops a pid sentinel in the
+    directory and removes it on clean exit; a sentinel whose pid is dead at
+    claim time means an unclean shutdown happened — every cache entry is
+    purged (recompiling is cheap and bounded; a poisoned entry is a
+    permanent crash). Returns the claimed directory path as a string."""
+    path = Path(cache_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    unclean = False
+    for f in path.glob("_inuse-*"):
+        try:
+            pid = int(f.name.split("-", 1)[1])
+        except ValueError:
+            pid = -1
+        if pid > 0 and _pid_alive(pid):
+            continue  # a live process is using the cache; leave its claim
+        unclean = True
+        f.unlink(missing_ok=True)
+    if unclean:
+        for pattern in ("*-cache", "*-atime"):
+            for f in path.glob(pattern):
+                f.unlink(missing_ok=True)
+    own = path / f"_inuse-{os.getpid()}"
+    own.write_text("")
+
+    def release(p=own):
+        p.unlink(missing_ok=True)
+
+    atexit.register(release)
+    return str(path)
+
+
+def enable_compile_cache(cache_dir: str | os.PathLike | None = None) -> str | None:
+    """Wire jax's persistent compile cache for THIS process (the in-process
+    counterpart of ``cpu_subprocess_env(compile_cache=...)``), claimed
+    crash-safe via :func:`claim_compile_cache`. ``cache_dir`` defaults to
+    ``$JAX_COMPILATION_CACHE_DIR``; returns None (no-op) when neither is
+    set. Used by the inference engine and benches so AOT-lowered serving
+    programs warm-start across processes."""
+    target = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not target:
+        return None
+    import jax
+
+    claimed = claim_compile_cache(target)
+    jax.config.update("jax_compilation_cache_dir", claimed)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return claimed
 
 
 def cpu_subprocess_env(

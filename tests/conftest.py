@@ -18,20 +18,36 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (may already be imported by sitecustomize)
 
 jax.config.update("jax_platforms", "cpu")
-# Persistent compilation cache: the suite's cost is XLA compiles of tiny
-# train steps, which are identical run-to-run — cache them across processes.
-# Keyed per host (utils/procenv.py host_fingerprint): XLA:CPU AOT entries
-# from another machine deserialize through a slow mismatch path that round 4
-# showed can straggle collective rendezvous into its abort window.
 _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 import sys  # noqa: E402
 
 sys.path.insert(0, _repo_root)
-from jumbo_mae_tpu_tpu.utils.procenv import host_cache_dir  # noqa: E402
 
-jax.config.update("jax_compilation_cache_dir", host_cache_dir(_repo_root))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+# Persistent compilation cache: OPT-IN for the main test process
+# (JUMBO_COMPILE_CACHE=1). The seed enabled it unconditionally, and the
+# round-6 seed triage traced the "seed tests failing" note to exactly that:
+# with this jaxlib (0.4.36), executing a train step deserialized from the
+# XLA:CPU AOT cache SIGABRTs the whole pytest session, load-order
+# dependently (reproduced at test_checkpoint.py::test_resume_equals_
+# uninterrupted and test_tools_eval_extract.py::test_eval_only_which_best;
+# every test passes with the cache off). Correctness beats the compile-time
+# saving, so the default is off. When opted in, the directory is claimed
+# crash-safe (utils/procenv.claim_compile_cache): a process killed
+# mid-cache-write — the tier-1 gate's own `timeout -k` — leaves permanently
+# truncated entries (jax's LRUCache.put is non-atomic and never
+# overwrites), and the claim purges the cache after any unclean shutdown.
+if os.environ.get("JUMBO_COMPILE_CACHE"):
+    from jumbo_mae_tpu_tpu.utils.procenv import (
+        claim_compile_cache,
+        host_cache_dir,
+    )
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        claim_compile_cache(host_cache_dir(_repo_root)),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import pytest  # noqa: E402
 

@@ -50,6 +50,14 @@ def ref():
     """Import the reference modules with missing-dependency stubs; everything
     injected into sys.modules/sys.path is removed afterwards (the reference's
     top-level names — modeling, utils, dataset … — are too generic to leak)."""
+    import os
+
+    if not os.path.isdir(REF_SRC):
+        pytest.skip(
+            f"reference checkout not present at {REF_SRC} — direct-parity "
+            "tests are environment-bound (the re-derived oracles in "
+            "test_models/test_train_steps cover the same numerics)"
+        )
     np.testing.assert_allclose(IMAGENET_MEAN, IMAGENET_DEFAULT_MEAN)
     np.testing.assert_allclose(IMAGENET_STD, IMAGENET_DEFAULT_STD)
 

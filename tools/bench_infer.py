@@ -1,0 +1,227 @@
+#!/usr/bin/env python3
+"""Two-leg inference benchmark: naive per-request jit vs the batched engine.
+
+Same discipline as the training bench (``bench.py``): both legs run the
+identical forward (the feature head by default) on the identical request
+stream — N single-image requests — and the JSON line reports throughput,
+latency percentiles, and compile counts for each leg:
+
+- **naive** — what a server without the engine does: one ``jax.jit``
+  forward per request at the request's own shape, dispatched serially.
+  Compiles lazily on the hot path (the first request pays it; a new shape
+  would pay it again) and wastes the MXU on batch-1 matmuls.
+- **engine** — requests submitted concurrently through the micro-batching
+  queue (``max_delay_ms``, ``max_batch``), coalesced into power-of-two
+  buckets served by AOT-compiled executables, all compiled during an
+  explicit warmup; the measured window recompiles nothing
+  (``recompiles_after_warmup`` is asserted into the JSON).
+
+    python tools/bench_infer.py                         # CPU smoke config
+    python tools/bench_infer.py recipes/finetune_vit_b16.yaml --ckpt C \
+        --task logits --requests 2048 --max-batch 64    # chip numbers
+
+Env-free by design — every knob is a flag; PERF.md §Inference records the
+methodology and numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "recipe",
+        nargs="?",
+        default=None,
+        help="YAML recipe (default: the CPU smoke profile — smoke_cpu.yaml "
+        "at patch 16, a per-request-overhead-dominated micro config that "
+        "isolates the coalescing mechanism on hosts where big batches are "
+        "compute-bound; chip numbers use real recipes)",
+    )
+    p.add_argument("--ckpt", default="", help="checkpoint (random init if omitted)")
+    p.add_argument(
+        "--task", choices=("features", "logits", "reconstruct"), default="features"
+    )
+    p.add_argument("--requests", type=int, default=1024, help="stream length")
+    p.add_argument("--clients", type=int, default=8, help="concurrent submitters")
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument(
+        "--rounds",
+        type=int,
+        default=3,
+        help="best-of-N throughput rounds per leg (same convention as the "
+        "training bench — shields the ratio from scheduler noise)",
+    )
+    p.add_argument("--max-delay-ms", type=float, default=2.0)
+    p.add_argument("--dtype", default=None, help="compute dtype override")
+    p.add_argument("--naive-requests", type=int, default=0,
+                   help="naive-leg stream length (default: min(requests, 128); "
+                   "the serial leg is slow by construction)")
+    p.add_argument("--out", default="", help="also write the JSON here")
+    p.add_argument(
+        "--set",
+        dest="overrides",
+        metavar="KEY.PATH=VALUE",
+        nargs="*",
+        action="extend",
+        default=[],
+        help="dotted config overrides, same grammar as cli.train",
+    )
+    return p
+
+
+def _percentiles(lat_s: list[float]) -> dict:
+    import numpy as np
+
+    ms = np.asarray(lat_s) * 1000.0
+    return {
+        "p50_ms": round(float(np.percentile(ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(ms, 99)), 3),
+        "mean_ms": round(float(ms.mean()), 3),
+    }
+
+
+def main(argv: list[str] | None = None) -> dict:
+    args = build_parser().parse_args(argv)
+
+    import concurrent.futures
+
+    import jax
+    import numpy as np
+
+    from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.infer import InferenceEngine, MicroBatcher
+
+    recipe = args.recipe
+    overrides = list(args.overrides)
+    if recipe is None:
+        recipe = str(REPO / "recipes" / "smoke_cpu.yaml")
+        # the smoke profile: few tokens per image, so per-request dispatch
+        # and sub-SIMD batch-1 GEMMs — the costs coalescing removes — are
+        # the dominant term even on a small CPU host
+        overrides = ["model.overrides.patch_size=16"] + overrides
+    cfg = load_config(recipe, overrides)
+    engine = InferenceEngine(
+        cfg, ckpt=args.ckpt, dtype=args.dtype, max_batch=args.max_batch
+    )
+    size = engine.image_size
+    rs = np.random.RandomState(0)
+    images = rs.randint(0, 256, (args.requests, size, size, 3)).astype(np.uint8)
+    kw = {"seed": 0} if args.task == "reconstruct" else {}
+
+    # ---- naive leg: serial per-request jit dispatch at batch 1 ----------
+    t = engine._task(args.task if args.task != "features" else "features")
+    fn = engine._fn(args.task, "cls" if args.task == "features" else None)
+    naive_fwd = jax.jit(fn)
+    n_naive = args.naive_requests or min(args.requests, 128)
+    extra = (np.int32(0),) if args.task == "reconstruct" else ()
+    # one untimed call so the measured window shows steady-state dispatch
+    # (the compile itself is reported separately below)
+    t0 = time.perf_counter()
+    jax.block_until_ready(naive_fwd(t["params"], images[:1], *extra))
+    naive_compile_s = time.perf_counter() - t0
+    fetch = (
+        (lambda o: {k: np.asarray(v) for k, v in o.items()})
+        if args.task == "reconstruct"
+        else np.asarray
+    )
+    lat = []
+    naive_wall = float("inf")
+    for _ in range(max(1, args.rounds)):
+        t0 = time.perf_counter()
+        for i in range(n_naive):
+            r0 = time.perf_counter()
+            fetch(naive_fwd(t["params"], images[i : i + 1], *extra))
+            lat.append(time.perf_counter() - r0)
+        naive_wall = min(naive_wall, time.perf_counter() - t0)
+    naive = {
+        "requests": n_naive,
+        "imgs_per_sec": round(n_naive / naive_wall, 2),
+        **_percentiles(lat),
+        "compiles": int(naive_fwd._cache_size()),
+        "first_request_compile_ms": round(naive_compile_s * 1000.0, 1),
+    }
+
+    # ---- engine leg: request stream through the micro-batcher -----------
+    # Two phases, because the two numbers answer different questions.
+    # Throughput: open-loop — the full stream enqueued as it arrives (an
+    # async server's event loop), wall time to drain it. Closed-loop
+    # clients would measure THREAD WAKEUP cost, not the engine: on a
+    # 1-core host, N blocking clients each pay a context switch per
+    # response. Latency: closed-loop with --clients concurrent blocking
+    # callers over a slice of the stream — each request's submit→result
+    # time under moderate concurrency, the number an operator quotes.
+    compiles_warm = engine.warmup((args.task,), buckets=None)
+    warm_counts = dict(engine.compile_counts)
+
+    def run_batch(batch):
+        return engine.predict(batch, task=args.task, **kw)
+
+    with MicroBatcher(
+        run_batch, max_batch=args.max_batch, max_delay_ms=args.max_delay_ms
+    ) as mb:
+        engine_wall = float("inf")
+        for _ in range(max(1, args.rounds)):
+            t0 = time.perf_counter()
+            futs = [mb.submit(img) for img in images]
+            # FIFO batcher: the last future resolves last — one waiter
+            # instead of one condition registration per request
+            futs[-1].result()
+            engine_wall = min(engine_wall, time.perf_counter() - t0)
+        sizes = list(mb.batch_sizes)
+
+        n_lat = min(args.requests, 256)
+        lat = [0.0] * n_lat
+
+        def client(idx):
+            r0 = time.perf_counter()
+            mb.submit(images[idx]).result()
+            lat[idx] = time.perf_counter() - r0
+
+        with concurrent.futures.ThreadPoolExecutor(args.clients) as pool:
+            list(pool.map(client, range(n_lat)))
+
+    recompiles = sum(engine.compile_counts.values()) - sum(warm_counts.values())
+    eng = {
+        "requests": args.requests,
+        "imgs_per_sec": round(args.requests / engine_wall, 2),
+        **_percentiles(lat),
+        "latency_requests": n_lat,
+        "latency_clients": args.clients,
+        "warmup_compiles": compiles_warm,
+        "recompiles_after_warmup": recompiles,
+        "mean_batch": round(float(np.mean(sizes)), 2),
+        "batches": len(sizes),
+    }
+
+    report = {
+        "bench": "infer",
+        "task": args.task,
+        "model": cfg.model.preset,
+        "image_size": size,
+        "backend": jax.default_backend(),
+        "max_batch": args.max_batch,
+        "max_delay_ms": args.max_delay_ms,
+        "clients": args.clients,
+        "naive": naive,
+        "engine": eng,
+        "speedup": round(eng["imgs_per_sec"] / naive["imgs_per_sec"], 2),
+    }
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        Path(args.out).write_text(line + "\n")
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -3,9 +3,11 @@
 
 Beyond the reference (which has no feature-export path — its frozen-trunk
 consumers are the inline linear/finetune modes, ``/root/reference/src/
-main_finetune.py``): restore a checkpoint, run the encoder deterministically
-(no masking, no dropout) over the validation split — or synthetic data —
-and write an ``.npz`` of pooled features plus labels where present.
+main_finetune.py``): restore a checkpoint once through the batched
+inference engine (``jumbo_mae_tpu_tpu/infer``), run the encoder
+deterministically (no masking, no dropout) over the validation split — or
+synthetic data — and write an ``.npz`` of pooled features plus labels
+where present.
 
     python tools/extract_features.py recipes/linear_sgd_vit_b16.yaml \
         --ckpt runs/pretrain/ckpt --out feats.npz --pool cls \
@@ -17,6 +19,9 @@ the patch tokens; ``tokens`` exports the full normed token sequence.
 ``--ckpt`` accepts an Orbax run/checkpoint directory or a ``.msgpack`` params
 file (either a pretrain tree with an ``encoder`` subtree, a classification
 tree with a ``model`` subtree, or a bare encoder tree).
+
+``extract_arrays`` is the library surface — ``tools/knn_probe.py`` calls it
+to extract either side of the probe on the fly from a recipe.
 """
 
 from __future__ import annotations
@@ -50,84 +55,26 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv: list[str] | None = None) -> Path:
-    args = build_parser().parse_args(argv)
-
+def extract_arrays(cfg, ckpt: str, pool: str):
+    """Run the recipe's validation stream through the inference engine's
+    feature head; returns ``(features, labels-or-None)`` with padded/invalid
+    rows dropped. Raises SystemExit on an empty stream or a checkpoint that
+    loads nothing (writing random-init features would be worse)."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from jumbo_mae_tpu_tpu.cli.train import make_valid_iterator
-    from jumbo_mae_tpu_tpu.config import load_config
-    from jumbo_mae_tpu_tpu.models import JumboViT, pool_tokens, preset
-    from jumbo_mae_tpu_tpu.ops.preprocess import normalize_images
+    from jumbo_mae_tpu_tpu.infer import InferenceEngine, bucket_for
     from jumbo_mae_tpu_tpu.parallel import create_mesh
-    from jumbo_mae_tpu_tpu.train.checkpoint import (
-        _ENCODER_KEYS,
-        load_params_tree,
-        merge_pretrained_params,
-        require_loaded,
-    )
 
-    if jax.process_count() > 1:
-        raise SystemExit(
-            "extract_features is a single-process tool; run it on one host"
-        )
-
-    cfg = load_config(args.recipe, args.overrides)
-    m = cfg.model
-    # the recipe's label count (read before the head is forced off below) —
-    # synthetic-data label export must match the recipe's class space
-    recipe_labels = m.overrides.get("labels")
-    enc_cfg = preset(
-        m.preset,
-        # forced last so recipe overrides (labels, mask_ratio for pretrain
-        # recipes, stochastic knobs) can't re-enable a head/masking/dropout
-        **{
-            **m.overrides,
-            "labels": None,
-            "mask_ratio": None,
-            "dropout": 0.0,
-            "droppath": 0.0,
-        },
-    )
-    model = JumboViT(enc_cfg)
+    # the recipe's label count — synthetic-data label export must match the
+    # recipe's class space (the engine forces its own encoder headless)
+    recipe_labels = cfg.model.overrides.get("labels")
     mesh = create_mesh(cfg.mesh)
-
     per_batch = max(1, cfg.run.valid_batch_size)
-    size = cfg.data.image_size
-    example = jnp.zeros((1, size, size, 3), jnp.uint8)
-    params = model.init(
-        jax.random.PRNGKey(cfg.run.init_seed),
-        normalize_images(example, dtype=enc_cfg.compute_dtype),
-        True,
-    )["params"]
-    if args.ckpt:
-        from flax import serialization
-
-        # pretrain trees keep the encoder under "encoder", classification
-        # trees under "model", a bare encoder export has neither — map any
-        # of the three onto this bare encoder before merging
-        tree = serialization.to_state_dict(load_params_tree(args.ckpt))
-        src = next((key for key in _ENCODER_KEYS if key in tree), None)
-        stats: dict = {}
-        merged = merge_pretrained_params(
-            tree[src] if src else tree,
-            serialization.to_state_dict(params),
-            stats=stats,
-        )
-        require_loaded(stats, args.ckpt, f"the {m.preset} encoder")
-        params = serialization.from_state_dict(params, merged)
-
-    k = enc_cfg.num_cls_tokens
-
-    @jax.jit
-    def fwd(params, images):
-        x = normalize_images(images, dtype=enc_cfg.compute_dtype)
-        tokens = model.apply({"params": params}, x, True)
-        feats = tokens if args.pool == "tokens" else pool_tokens(tokens, k, args.pool)
-        return feats.astype(jnp.float32)
-
+    engine = InferenceEngine(
+        cfg, ckpt=ckpt, max_batch=bucket_for(per_batch, 1024)
+    )
     valid_factory = make_valid_iterator(
         cfg, mesh, per_batch, num_labels=recipe_labels or 1000
     )
@@ -139,7 +86,8 @@ def main(argv: list[str] | None = None) -> Path:
     all_feats: list[np.ndarray] = []
     all_labels: list[np.ndarray] = []
     for batch in valid_factory():
-        feats = np.asarray(jax.device_get(fwd(params, batch["images"])))
+        images = np.asarray(jax.device_get(batch["images"]))
+        feats = engine.features(images, pool=pool)
         valid = np.asarray(
             jax.device_get(batch.get("valid", np.ones(feats.shape[0], bool)))
         ).astype(bool)
@@ -148,22 +96,39 @@ def main(argv: list[str] | None = None) -> Path:
             labels = np.asarray(jax.device_get(batch["labels"]))
             all_labels.append(labels[valid])
 
-    total = sum(f.shape[0] for f in all_feats)
-    if total == 0:
+    if sum(f.shape[0] for f in all_feats) == 0:
         raise SystemExit(
             "no valid samples in the stream — check data.valid_shards "
             "matches non-empty shards (or run.synthetic_data=true)"
         )
+    features = np.concatenate(all_feats, axis=0)
+    labels = np.concatenate(all_labels, axis=0) if all_labels else None
+    return features, labels
+
+
+def main(argv: list[str] | None = None) -> Path:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from jumbo_mae_tpu_tpu.config import load_config
+
+    if jax.process_count() > 1:
+        raise SystemExit(
+            "extract_features is a single-process tool; run it on one host"
+        )
+
+    cfg = load_config(args.recipe, args.overrides)
+    features, labels = extract_arrays(cfg, args.ckpt, args.pool)
+
     out = Path(args.out)
-    payload = {
-        "features": np.concatenate(all_feats, axis=0),
-        "pool": np.asarray(args.pool),
-    }
-    if all_labels:
-        payload["labels"] = np.concatenate(all_labels, axis=0)
+    payload = {"features": features, "pool": np.asarray(args.pool)}
+    if labels is not None:
+        payload["labels"] = labels
     out.parent.mkdir(parents=True, exist_ok=True)
     np.savez(out, **payload)
-    n, shape = payload["features"].shape[0], payload["features"].shape[1:]
+    n, shape = features.shape[0], features.shape[1:]
     print(f"[extract] wrote {n} x {shape} {args.pool} features -> {out}")
     return out
 

@@ -12,14 +12,25 @@ reference, whose only probe is the trained BatchNorm+linear head.
     python tools/extract_features.py cfg.yaml --ckpt C --out val.npz
     python tools/knn_probe.py train.npz val.npz [--k 20] [--temp 0.07]
 
-Both inputs must be ``.npz`` files with ``features`` and ``labels`` arrays
-(as written by extract_features). Prints one JSON line with top-1 accuracy.
+Each input is either an ``.npz`` file with ``features`` and ``labels``
+arrays (as written by extract_features) or a ``.yaml`` recipe — recipe
+inputs are extracted on the fly through the batched inference engine
+(``extract_features.extract_arrays``), sharing one restored checkpoint:
+
+    python tools/knn_probe.py train.yaml val.yaml --ckpt runs/x/ckpt \
+        [--pool cls] [--set data.workers=0]
+
+Prints one JSON line with top-1 accuracy.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def knn_predict(
@@ -63,23 +74,55 @@ def knn_predict(
     return np.concatenate(preds)
 
 
-def main(argv: list[str] | None = None) -> float:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("train_npz", help="features+labels of the reference set")
-    p.add_argument("query_npz", help="features+labels to evaluate")
-    p.add_argument("--k", type=int, default=20)
-    p.add_argument("--temp", type=float, default=0.07)
-    args = p.parse_args(argv)
-
+def _load_side(path: str, name: str, args) -> dict:
+    """One probe side: a ready .npz, or a .yaml recipe extracted through the
+    inference engine (features + labels, invalid rows already dropped)."""
     import numpy as np
 
-    train = np.load(args.train_npz)
-    query = np.load(args.query_npz)
-    for name, z in (("train", train), ("query", query)):
-        if "labels" not in z:
+    if path.endswith((".yaml", ".yml")):
+        from extract_features import extract_arrays
+
+        from jumbo_mae_tpu_tpu.config import load_config
+
+        cfg = load_config(path, args.overrides)
+        features, labels = extract_arrays(cfg, args.ckpt, args.pool)
+        if labels is None:
             raise SystemExit(
-                f"{name} file has no labels — extract from a labeled split"
+                f"{name} recipe {path} yields no labels — probe needs a "
+                "labeled split"
             )
+        return {"features": features, "labels": labels}
+    z = np.load(path)
+    if "labels" not in z:
+        raise SystemExit(
+            f"{name} file has no labels — extract from a labeled split"
+        )
+    return z
+
+
+def main(argv: list[str] | None = None) -> float:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("train_npz", help="reference set: .npz or .yaml recipe")
+    p.add_argument("query_npz", help="set to evaluate: .npz or .yaml recipe")
+    p.add_argument("--k", type=int, default=20)
+    p.add_argument("--temp", type=float, default=0.07)
+    p.add_argument(
+        "--ckpt", default="", help="checkpoint for .yaml recipe inputs"
+    )
+    p.add_argument("--pool", choices=("cls", "gap"), default="cls")
+    p.add_argument(
+        "--set",
+        dest="overrides",
+        metavar="KEY.PATH=VALUE",
+        nargs="*",
+        action="extend",
+        default=[],
+        help="dotted config overrides for .yaml inputs, same grammar as cli.train",
+    )
+    args = p.parse_args(argv)
+
+    train = _load_side(args.train_npz, "train", args)
+    query = _load_side(args.query_npz, "query", args)
     preds = knn_predict(
         train["features"], train["labels"], query["features"],
         k=args.k, temp=args.temp,
