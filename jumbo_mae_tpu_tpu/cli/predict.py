@@ -72,6 +72,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="serving compute dtype override (e.g. float32 for the exact path)",
     )
     p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve Prometheus /metrics + /healthz on this port "
+        "(0 = any free port, printed at startup; omit to disable)",
+    )
+    p.add_argument(
+        "--metrics-hold-s",
+        type=float,
+        default=0.0,
+        help="keep the exporter up N seconds after the requests finish "
+        "(lets an external scraper read the final counters; CI smoke uses it)",
+    )
+    p.add_argument(
         "--set",
         dest="overrides",
         metavar="KEY.PATH=VALUE",
@@ -98,11 +113,25 @@ def main(argv: list[str] | None = None) -> Path | None:
         raise SystemExit("pass exactly one of --images or --synthetic N")
 
     cfg = load_config(args.config, args.overrides)
+
+    telemetry = None
+    health = None
+    if args.metrics_port is not None:
+        from jumbo_mae_tpu_tpu.obs import HealthState, TelemetryServer
+
+        health = HealthState()  # not ready until the engine is constructed
+        telemetry = TelemetryServer(health=health, port=args.metrics_port).start()
+        print(f"[predict] exporter on :{telemetry.port} (/metrics, /healthz)")
+
     engine = InferenceEngine(
         cfg, ckpt=args.ckpt, dtype=args.dtype, max_batch=args.max_batch
     )
     if args.ckpt == "":
         print("[predict] WARNING: no --ckpt — serving a random init")
+    if health is not None:
+        health.set_ready(
+            True, detail=f"engine up (ckpt={'yes' if args.ckpt else 'random'})"
+        )
 
     size = engine.image_size
     if args.synthetic:
@@ -133,8 +162,13 @@ def main(argv: list[str] | None = None) -> Path | None:
         {"seed": args.seed} if args.task == "reconstruct" else {}
     )
     if args.serve:
+        def run_fn(batch):
+            if health is not None:
+                health.beat("infer_batch")
+            return engine.predict(batch, task=args.task, **kw)
+
         with MicroBatcher(
-            lambda batch: engine.predict(batch, task=args.task, **kw),
+            run_fn,
             max_batch=args.max_batch,
             max_delay_ms=args.max_delay_ms,
         ) as mb:
@@ -164,13 +198,23 @@ def main(argv: list[str] | None = None) -> Path | None:
                 )
             )
     payload = out if isinstance(out, dict) else {args.task: out}
-    if not args.out:
-        return None
-    path = Path(args.out)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    np.savez(path, **payload)
-    print(f"[predict] wrote {args.task} for {len(names)} image(s) -> {path}")
-    return path
+    result: Path | None = None
+    if args.out:
+        result = Path(args.out)
+        result.parent.mkdir(parents=True, exist_ok=True)
+        np.savez(result, **payload)
+        print(f"[predict] wrote {args.task} for {len(names)} image(s) -> {result}")
+    if telemetry is not None:
+        if args.metrics_hold_s > 0:
+            import time
+
+            print(
+                f"[predict] holding exporter for {args.metrics_hold_s:g}s "
+                f"(scrape :{telemetry.port}/metrics)"
+            )
+            time.sleep(args.metrics_hold_s)
+        telemetry.close()
+    return result
 
 
 if __name__ == "__main__":

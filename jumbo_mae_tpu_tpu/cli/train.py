@@ -17,6 +17,7 @@ Run:
 from __future__ import annotations
 
 import argparse
+import time
 from pathlib import Path
 
 import jax
@@ -51,6 +52,15 @@ from jumbo_mae_tpu_tpu.train import (
     make_optimizer,
     make_train_step,
 )
+from jumbo_mae_tpu_tpu.obs import (
+    HealthState,
+    TelemetryServer,
+    export_chrome_trace,
+    get_registry,
+    span_timer,
+    start_chrome_trace,
+    trace,
+)
 from jumbo_mae_tpu_tpu.utils import (
     AverageMeter,
     MetricLogger,
@@ -60,7 +70,6 @@ from jumbo_mae_tpu_tpu.utils import (
     param_summary,
     pretrain_flops_per_image,
 )
-from jumbo_mae_tpu_tpu.utils.profiling import trace
 
 
 def build_model(cfg: TrainConfig):
@@ -584,6 +593,20 @@ def train(cfg: TrainConfig) -> dict:
         # eval_only has no step loop to honor the flag and nothing to
         # checkpoint — default signal behavior (exit now) is the honest one
         preempt.install()
+    # telemetry: metrics always record into the process registry; the HTTP
+    # exporter (/metrics + /healthz) is opt-in per recipe. State is built and
+    # (if requested) restored by this point, so readiness is honest.
+    health = HealthState()
+    health.set_ready(True, detail=f"mode={run.mode} start_step={start_step}")
+    telemetry = None
+    if run.telemetry and is_main:
+        telemetry = TelemetryServer(
+            health=health, host=run.telemetry_host, port=run.telemetry_port
+        ).start()
+        print(
+            f"[obs] exporter on {run.telemetry_host}:{telemetry.port} "
+            "(/metrics, /healthz)"
+        )
     logger = MetricLogger(
         Path(run.output_dir) / run.name,
         name=run.name,
@@ -626,6 +649,8 @@ def train(cfg: TrainConfig) -> dict:
         if ckpt is not None:
             ckpt.close()
         logger.close()
+        if telemetry is not None:
+            telemetry.close()
         return val
 
     if run.sanity_eval and valid_factory is not None:
@@ -643,10 +668,41 @@ def train(cfg: TrainConfig) -> dict:
     n_chips = len(jax.devices())
     last_metrics: dict[str, float] = {}
 
+    # step-loop telemetry: spans aggregate into span_seconds{name=...}; the
+    # gauges publish the log-window derived numbers the logger prints.
+    # train_step spans measure DISPATCH (the loop syncs only at log
+    # boundaries); true step wall time is the steps_per_sec the MFU uses.
+    reg = get_registry()
+    g_mfu = reg.gauge("train_mfu", "model FLOP utilization (log-window)")
+    g_ips = reg.gauge("train_images_per_sec", "global throughput (log-window)")
+    g_wait_frac = reg.gauge(
+        "train_data_wait_fraction", "share of wall time waiting on data"
+    )
+    g_step = reg.gauge("train_step", "current absolute step")
+    c_steps = reg.counter("train_steps_total", "optimizer steps this process")
+    sp_wait = span_timer("data_wait")
+    sp_step = span_timer("train_step")
+    sp_ckpt = span_timer("checkpoint_save")
+    # liveness: a wedged collective / dead loader flips /healthz to 503 well
+    # before an operator would spot a silent stall in the logs
+    health.watch("train_step", max_age_s=3600.0)
+    health.watch("data_batch", max_age_s=3600.0)
+    if run.chrome_trace and is_main:
+        start_chrome_trace()
+    window_t0, window_wait = time.perf_counter(), 0.0
+
     with trace(run.profile_dir or None):
         pending: list = []
         for step in range(start_step + 1, run.training_steps + 1):
-            state, metrics = train_step(state, next(train_iter))
+            with sp_wait:
+                batch = next(train_iter)
+            window_wait += sp_wait.last_s
+            health.beat("data_batch")
+            with sp_step:
+                state, metrics = train_step(state, batch)
+            c_steps.inc()
+            g_step.set(step)
+            health.beat("train_step")
             pending.append(metrics)  # device arrays; fetched at log time
             timer.tick()
             # only cursor_log[step] (and prefetched future steps) are ever
@@ -672,6 +728,11 @@ def train(cfg: TrainConfig) -> dict:
                         "perf/mfu": rep.mfu,
                         "perf/tflops_per_chip": rep.achieved_tflops,
                     }
+                    g_mfu.set(rep.mfu)
+                    g_ips.set(imgs)
+                now = time.perf_counter()
+                g_wait_frac.set(window_wait / max(now - window_t0, 1e-9))
+                window_t0, window_wait = now, 0.0
                 logger.log(summary, step=step)
                 last_metrics = summary
 
@@ -685,9 +746,11 @@ def train(cfg: TrainConfig) -> dict:
                     val = evaluate(eval_step, state, valid_factory(), pad_batch)
                     logger.log(val, step=step)
                     last_metrics |= val
-                    ckpt.save(step, state, metrics=val, extra=extra)
+                    with sp_ckpt:
+                        ckpt.save(step, state, metrics=val, extra=extra)
                 else:
-                    ckpt.save(step, state, extra=extra)
+                    with sp_ckpt:
+                        ckpt.save(step, state, extra=extra)
                 saved_this_step = True
 
             # Graceful preemption: single-host checks the flag every step;
@@ -702,17 +765,22 @@ def train(cfg: TrainConfig) -> dict:
             if boundary and _agree_on_preemption(preempt, process_count):
                 if not saved_this_step:
                     snap = _gather_data_cursor(cursor_log.get(step))
-                    ckpt.save(
-                        step,
-                        state,
-                        extra={"data_cursor": snap} if snap is not None else None,
-                    )
+                    with sp_ckpt:
+                        ckpt.save(
+                            step,
+                            state,
+                            extra={"data_cursor": snap} if snap is not None else None,
+                        )
                 print(f"[train] preemption checkpoint at step {step}; exiting")
                 break
 
     ckpt.wait()
     ckpt.close()
     logger.close()
+    if run.chrome_trace and is_main:
+        print(f"[obs] chrome trace -> {export_chrome_trace(run.chrome_trace)}")
+    if telemetry is not None:
+        telemetry.close()
     if source is not None:
         source.close()
     return last_metrics

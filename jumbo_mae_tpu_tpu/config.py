@@ -84,6 +84,15 @@ class RunConfig:
     resume: bool = False
     pretrained_ckpt: str = ""
     profile_dir: str = ""
+    # telemetry (jumbo_mae_tpu_tpu/obs): metrics are always *recorded*; the
+    # exporter serving them over HTTP (/metrics Prometheus text, /healthz)
+    # is opt-in. Port 0 binds any free port (the chosen one is printed).
+    telemetry: bool = False
+    telemetry_port: int = 9100
+    telemetry_host: str = "0.0.0.0"
+    # write the host-side span timeline (chrome://tracing / Perfetto JSON)
+    # here at the end of the run; complements profile_dir's XLA device trace
+    chrome_trace: str = ""
     use_wandb: bool = True
     wandb_project: str = ""
     wandb_entity: str = ""

@@ -24,6 +24,7 @@ transfer with no overlap).
 from __future__ import annotations
 
 import queue as queue_mod
+import time
 from collections.abc import Iterator
 from dataclasses import dataclass
 from pathlib import Path
@@ -31,6 +32,7 @@ from pathlib import Path
 import numpy as np
 
 from jumbo_mae_tpu_tpu.data.decode import decode_image, decode_label, find_image_key
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
 from jumbo_mae_tpu_tpu.data.randaugment import auto_augment_factory
 from jumbo_mae_tpu_tpu.data.shards import expand_shards, shuffle_shards, split_shards
 from jumbo_mae_tpu_tpu.data.tario import iter_shards_samples
@@ -178,6 +180,12 @@ def train_sample_stream(
     """
     shards = expand_shards(cfg.train_shards)
     transform = TrainTransform(cfg)
+    # per-sample decode time — in a worker subprocess this lands in that
+    # process's own registry (unexported), in the inline/native path it
+    # feeds the exporter directly
+    m_decode = get_registry().histogram(
+        "data_decode_seconds", "image decode time per sample"
+    )
     epoch = start_epoch
     to_skip = max(0, skip_samples)
     while True:
@@ -197,7 +205,9 @@ def train_sample_stream(
                 img_key = find_image_key(sample)
                 if img_key is None:
                     continue
+                t0 = time.perf_counter()
                 img = decode_image(sample[img_key])  # type: ignore[arg-type]
+                m_decode.observe(time.perf_counter() - t0)
                 if img is None:
                     continue
                 label = decode_label(sample["cls"]) if "cls" in sample else -1
@@ -268,6 +278,9 @@ def native_train_stream(
 
     shards = expand_shards(cfg.train_shards)
     transform = TrainTransform(cfg)
+    m_decode = get_registry().histogram(
+        "data_decode_seconds", "image decode time per sample"
+    )
     epoch = start_epoch
     to_skip = max(0, skip_samples)
     with ThreadPoolExecutor(max_workers=max(1, cfg.decode_threads)) as pool:
@@ -281,7 +294,9 @@ def native_train_stream(
 
             def decode_one(pair):
                 payload, label = pair
+                t0 = time.perf_counter()
                 img = decode_image(payload)
+                m_decode.observe(time.perf_counter() - t0)
                 return None if img is None else (img, label)
 
             def decoded(reader):
@@ -479,6 +494,23 @@ class TrainLoader:
         self.cfg = cfg
         self.batch_size = batch_size
         self._workers: list[_Worker] = []
+        # loader telemetry (obs/metrics.py): how long the train loop waits
+        # for batches, and whether workers are stalling or dying under it
+        reg = get_registry()
+        self._m_wait = reg.histogram(
+            "data_batch_wait_seconds", "host wait in TrainLoader.__next__"
+        )
+        self._m_batches = reg.counter(
+            "data_batches_total", "train batches yielded"
+        )
+        self._m_stalls = reg.counter(
+            "data_worker_stalls_total",
+            "5 s waits on an alive worker's empty queue",
+            labels=("worker",),
+        )
+        self._m_deaths = reg.counter(
+            "data_worker_deaths_total", "workers found dead at read time"
+        )
         if cfg.use_native:
             # the C++ reader's deterministic per-thread shard ownership +
             # round-robin merge makes this stream a pure function of
@@ -588,6 +620,7 @@ class TrainLoader:
         return self
 
     def __next__(self) -> dict[str, np.ndarray]:
+        t_wait = time.perf_counter()
         if self._inline is not None:
             batch = next(self._inline)
             slot = 0
@@ -599,6 +632,7 @@ class TrainLoader:
                 if w.dead and w.queue.empty():
                     # skipping a dead worker would silently fork the batch
                     # sequence away from the deterministic schedule
+                    self._m_deaths.inc()
                     raise RuntimeError(
                         f"data worker {slot} died; deterministic stream lost"
                     )
@@ -606,12 +640,15 @@ class TrainLoader:
                     batch = w.queue.get(timeout=5)
                     break
                 except queue_mod.Empty:
+                    self._m_stalls.labels(str(slot)).inc()
                     attempts_left -= 1
                     if attempts_left <= 0:
                         raise RuntimeError(
                             f"data worker {slot} alive but produced nothing "
                             "for 10 minutes"
                         ) from None
+        self._m_wait.observe(time.perf_counter() - t_wait)
+        self._m_batches.inc()
         cur = batch.pop("_cursor", None)
         if cur is not None:
             self._cursors[slot] = (int(cur[0]), int(cur[1]))

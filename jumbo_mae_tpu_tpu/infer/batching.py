@@ -25,10 +25,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Callable
 
 import numpy as np
+
+from jumbo_mae_tpu_tpu.obs.metrics import RATIO_BUCKETS, get_registry
 
 _STOP = object()
 
@@ -48,6 +51,7 @@ class MicroBatcher:
         *,
         max_batch: int = 32,
         max_delay_ms: float = 5.0,
+        registry=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -55,6 +59,31 @@ class MicroBatcher:
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1000.0
         self.batch_sizes: list[int] = []
+        # serving telemetry (obs/metrics.py): submit→result latency is THE
+        # operator number — it includes coalescing wait, queueing behind
+        # in-flight batches, and the forward itself
+        reg = registry if registry is not None else get_registry()
+        self._m_latency = reg.histogram(
+            "infer_request_latency_seconds",
+            "request latency: submit() to resolved future",
+        )
+        self._m_occupancy = reg.histogram(
+            "infer_batch_occupancy",
+            "flushed batch size / max_batch",
+            buckets=RATIO_BUCKETS,
+        )
+        self._m_depth = reg.gauge(
+            "infer_queue_depth", "queued requests sampled at batch collect"
+        )
+        self._m_requests = reg.counter(
+            "infer_requests_total", "requests collected into batches"
+        )
+        self._m_batches = reg.counter(
+            "infer_batches_total", "batches flushed through run_fn"
+        )
+        self._m_failed = reg.counter(
+            "infer_requests_failed_total", "requests failed by a run_fn error"
+        )
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._closed = False
         self._thread = threading.Thread(
@@ -70,7 +99,9 @@ class MicroBatcher:
         if self._closed:
             raise RuntimeError("MicroBatcher is closed")
         fut: Future = Future()
-        self._q.put((np.asarray(image), fut))
+        # submit stays metric-free (counted batch-at-a-time in _flush): at
+        # CPU-smoke request rates even one lock per submit is measurable
+        self._q.put((np.asarray(image), fut, time.perf_counter()))
         return fut
 
     def __call__(self, image: np.ndarray):
@@ -93,13 +124,12 @@ class MicroBatcher:
     # ---------------------------------------------------------- collector
 
     def _loop(self):
-        import time
-
         while True:
             item = self._q.get()
             if item is _STOP:
                 return
             batch = [item]
+            self._m_depth.set(self._q.qsize() + 1)
             deadline = time.monotonic() + self.max_delay
             stop = False
             while len(batch) < self.max_batch:
@@ -120,15 +150,23 @@ class MicroBatcher:
 
     def _flush(self, batch):
         self.batch_sizes.append(len(batch))
+        self._m_batches.inc()
+        self._m_requests.inc(len(batch))
+        self._m_occupancy.observe(len(batch) / self.max_batch)
         try:
-            out = self.run_fn(np.stack([img for img, _ in batch]))
+            out = self.run_fn(np.stack([img for img, _, _ in batch]))
         except BaseException as e:  # noqa: BLE001 — route to the waiters
-            for _, fut in batch:
+            self._m_failed.inc(len(batch))
+            for _, fut, _ in batch:
                 fut.set_exception(e)
             return
+        done = time.perf_counter()
+        # one lock hand-off for the whole batch's latencies, before the
+        # waiters wake (their submit→result time must not include it)
+        self._m_latency.observe_many([done - t for _, _, t in batch])
         if isinstance(out, dict):
-            for i, (_, fut) in enumerate(batch):
+            for i, (_, fut, _) in enumerate(batch):
                 fut.set_result({k: v[i] for k, v in out.items()})
         else:
-            for (_, fut), row in zip(batch, out):
+            for (_, fut, _), row in zip(batch, out):
                 fut.set_result(row)

@@ -41,6 +41,7 @@ machinery stays in the training stack.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -48,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from jumbo_mae_tpu_tpu.config import TrainConfig
+from jumbo_mae_tpu_tpu.obs.metrics import RATIO_BUCKETS, get_registry
 from jumbo_mae_tpu_tpu.models import (
     DecoderConfig,
     JumboViT,
@@ -114,10 +116,43 @@ class InferenceEngine:
         batch_norm: bool | None = None,
         on_compile: Callable[[str, int], None] | None = None,
         compile_cache: str | None = None,
+        registry=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         enable_compile_cache(compile_cache)
+        # telemetry handles resolved once (obs/metrics.py): the hot path only
+        # ever pays a counter inc / histogram observe, and a NullRegistry
+        # default turns every site into a no-op with no branches here
+        reg = registry if registry is not None else get_registry()
+        self._m_predict = reg.histogram(
+            "infer_predict_seconds",
+            "engine predict() wall time per batched call",
+            labels=("task",),
+        )
+        self._m_images = reg.counter(
+            "infer_images_total", "images served", labels=("task",)
+        )
+        self._m_hits = reg.counter(
+            "infer_bucket_cache_hits_total",
+            "bucket-executable cache hits",
+            labels=("task",),
+        )
+        self._m_misses = reg.counter(
+            "infer_bucket_cache_misses_total",
+            "bucket-executable cache misses (each one is a compile)",
+            labels=("task",),
+        )
+        self._m_compile = reg.histogram(
+            "infer_compile_seconds",
+            "AOT lower+compile time per (task, bucket) executable",
+            labels=("task",),
+        )
+        self._m_pad = reg.histogram(
+            "infer_pad_fraction",
+            "padding rows / bucket size per dispatched chunk",
+            buckets=RATIO_BUCKETS,
+        )
         self.cfg = cfg
         self.max_batch = int(max_batch)
         self.on_compile = on_compile
@@ -314,11 +349,15 @@ class InferenceEngine:
         key = (self._task_key(task, pool), bucket)
         ex = self._exec.get(key)
         if ex is not None:
+            self._m_hits.labels(key[0]).inc()
             return ex
         with self._lock:
             ex = self._exec.get(key)
             if ex is not None:
+                self._m_hits.labels(key[0]).inc()
                 return ex
+            self._m_misses.labels(key[0]).inc()
+            t_compile = time.perf_counter()
             t = self._task(task)
             size = self.image_size
             images = jax.ShapeDtypeStruct((bucket, size, size, 3), jnp.uint8)
@@ -336,6 +375,9 @@ class InferenceEngine:
             )
             self._exec[key] = ex
             self.compile_counts[key] = self.compile_counts.get(key, 0) + 1
+            self._m_compile.labels(key[0]).observe(
+                time.perf_counter() - t_compile
+            )
             if self.on_compile is not None:
                 self.on_compile(key[0], bucket)
             return ex
@@ -371,6 +413,7 @@ class InferenceEngine:
         """Bucket-pad one chunk (len <= max_batch), run, slice valid rows."""
         n = images.shape[0]
         bucket = bucket_for(n, self.max_batch)
+        self._m_pad.observe((bucket - n) / bucket)
         if n < bucket:
             pad = np.zeros((bucket - n, *images.shape[1:]), images.dtype)
             images = np.concatenate([images, pad])
@@ -379,6 +422,7 @@ class InferenceEngine:
         return jax.tree_util.tree_map(lambda a: np.asarray(a)[:n], out)
 
     def _predict(self, task: str, images, *, pool=None, extra=()):
+        t0 = time.perf_counter()
         images = np.asarray(images)
         if images.ndim == 3:
             images = images[None]
@@ -394,11 +438,14 @@ class InferenceEngine:
             self._run(task, pool, images[i : i + self.max_batch], extra)
             for i in range(0, images.shape[0], self.max_batch)
         ]
-        if len(chunks) == 1:
-            return chunks[0]
-        return jax.tree_util.tree_map(
-            lambda *xs: np.concatenate(xs), *chunks
+        out = (
+            chunks[0]
+            if len(chunks) == 1
+            else jax.tree_util.tree_map(lambda *xs: np.concatenate(xs), *chunks)
         )
+        self._m_predict.labels(task).observe(time.perf_counter() - t0)
+        self._m_images.labels(task).inc(images.shape[0])
+        return out
 
     def features(self, images, *, pool: str = "cls") -> np.ndarray:
         """Pooled (or full-token) float32 encoder features, one row per

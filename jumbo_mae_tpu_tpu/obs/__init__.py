@@ -1,0 +1,79 @@
+"""Observability subsystem: one registry across serve / train / data.
+
+- ``obs.metrics``  — thread-safe counters/gauges/histograms with labels,
+  Prometheus text rendering, process default registry (+ null registry for
+  telemetry-off A/B runs); hosts ``AverageMeter``.
+- ``obs.exporter`` — stdlib HTTP server for ``/metrics`` and ``/healthz``.
+- ``obs.trace``    — host-side spans aggregating into the registry, optional
+  chrome-trace export, and the XLA device-trace capture helpers.
+- ``obs.mfu``      — analytic FLOPs + MFU reporting (fed into the registry
+  by the train loop).
+
+The former ``utils/meters.py`` / ``utils/mfu.py`` / ``utils/profiling.py``
+modules remain as import-compatible shims over this package.
+"""
+
+from jumbo_mae_tpu_tpu.obs.exporter import HealthState, TelemetryServer
+from jumbo_mae_tpu_tpu.obs.metrics import (
+    LATENCY_BUCKETS,
+    NULL_REGISTRY,
+    RATIO_BUCKETS,
+    AverageMeter,
+    Counter,
+    Family,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NullRegistry,
+    get_registry,
+    set_registry,
+)
+from jumbo_mae_tpu_tpu.obs.mfu import (
+    PEAK_TFLOPS,
+    MfuReport,
+    classify_flops_per_image,
+    detect_peak_tflops,
+    encoder_flops_per_image,
+    mfu_report,
+    pretrain_flops_per_image,
+)
+from jumbo_mae_tpu_tpu.obs.trace import (
+    annotate,
+    export_chrome_trace,
+    span,
+    span_timer,
+    start_chrome_trace,
+    stop_chrome_trace,
+    trace,
+)
+
+__all__ = [
+    "AverageMeter",
+    "Counter",
+    "Family",
+    "Gauge",
+    "HealthState",
+    "Histogram",
+    "LATENCY_BUCKETS",
+    "MetricsRegistry",
+    "MfuReport",
+    "NULL_REGISTRY",
+    "NullRegistry",
+    "PEAK_TFLOPS",
+    "RATIO_BUCKETS",
+    "TelemetryServer",
+    "annotate",
+    "classify_flops_per_image",
+    "detect_peak_tflops",
+    "encoder_flops_per_image",
+    "export_chrome_trace",
+    "get_registry",
+    "mfu_report",
+    "pretrain_flops_per_image",
+    "set_registry",
+    "span",
+    "span_timer",
+    "start_chrome_trace",
+    "stop_chrome_trace",
+    "trace",
+]

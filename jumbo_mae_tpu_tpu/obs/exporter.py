@@ -1,0 +1,164 @@
+"""Stdlib-only HTTP exporter: Prometheus ``/metrics`` + ``/healthz``.
+
+The externally scrapeable surface of the telemetry subsystem. Opt-in from
+both entry points (``run.telemetry`` in ``cli/train.py``, ``--metrics-port``
+in ``cli/predict.py``); a scrape never touches the hot path — it reads the
+registry under the same per-metric locks the instrument sites use, so the
+worst contention is one lock hand-off per metric per scrape.
+
+``/healthz`` answers the operator questions the ROADMAP's serving story
+needs: is the process *ready* (engine warm / state restored), and are its
+loops *live* (last-step age, loader liveness) — each liveness check is a
+named heartbeat with a max age, registered by whoever owns the loop.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry, get_registry
+
+
+class HealthState:
+    """Thread-safe readiness + liveness state behind ``/healthz``.
+
+    Readiness is a single flag (set when the serving/training state is
+    usable). Liveness is a set of named heartbeats: ``watch(name, max_age_s)``
+    registers the requirement, ``beat(name)`` is the one-liner the owning
+    loop calls. The report is unhealthy if not ready, or any watched
+    heartbeat is older than its budget (a watched name never beaten is
+    age-infinite, i.e. unhealthy — a loop that never started is not live).
+    """
+
+    def __init__(self, *, ready: bool = False):
+        self._lock = threading.Lock()
+        self._ready = bool(ready)
+        self._detail = ""
+        self._max_age: dict[str, float] = {}
+        self._beats: dict[str, float] = {}
+
+    def set_ready(self, ready: bool = True, detail: str = "") -> None:
+        with self._lock:
+            self._ready = bool(ready)
+            self._detail = detail
+
+    def watch(self, name: str, max_age_s: float) -> None:
+        with self._lock:
+            self._max_age[name] = float(max_age_s)
+
+    def unwatch(self, name: str) -> None:
+        """Drop a liveness requirement (e.g. the loader finished cleanly)."""
+        with self._lock:
+            self._max_age.pop(name, None)
+
+    def beat(self, name: str) -> None:
+        # monotonic: wall-clock jumps must not flip health
+        self._beats[name] = time.monotonic()
+
+    def report(self) -> tuple[bool, dict]:
+        now = time.monotonic()
+        with self._lock:
+            ready, detail = self._ready, self._detail
+            watches = dict(self._max_age)
+        checks = {}
+        ok = ready
+        for name, budget in sorted(watches.items()):
+            last = self._beats.get(name)
+            age = None if last is None else now - last
+            alive = age is not None and age <= budget
+            ok = ok and alive
+            checks[name] = {
+                "age_s": None if age is None else round(age, 3),
+                "max_age_s": budget,
+                "ok": alive,
+            }
+        body = {"ok": ok, "ready": ready, "checks": checks}
+        if detail:
+            body["detail"] = detail
+        return ok, body
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "_Server"
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.server.registry.render().encode()
+            self._reply(200, body, "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            ok, report = self.server.health.report()
+            body = (json.dumps(report) + "\n").encode()
+            self._reply(200 if ok else 503, body, "application/json")
+        else:
+            self._reply(404, b"not found\n", "text/plain")
+
+    def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass  # scrapes must not spam the training log
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    registry: MetricsRegistry
+    health: HealthState
+
+
+class TelemetryServer:
+    """The exporter: serve ``registry`` and ``health`` over HTTP in a daemon
+    thread. ``port=0`` binds any free port (tests/CI); the bound port is
+    ``self.port`` after ``start()``. Use as a context manager or ``close()``.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        health: HealthState | None = None,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 9100,
+    ):
+        self.registry = registry if registry is not None else get_registry()
+        self.health = health if health is not None else HealthState(ready=True)
+        self.host = host
+        self.port = int(port)
+        self._httpd: _Server | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "TelemetryServer":
+        if self._httpd is not None:
+            return self
+        httpd = _Server((self.host, self.port), _Handler)
+        httpd.registry = self.registry
+        httpd.health = self.health
+        self.port = httpd.server_address[1]
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, daemon=True, name="telemetry-exporter"
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+                self._thread = None
+
+    def __enter__(self) -> "TelemetryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,30 +1,7 @@
-"""Host-side metric aggregation.
+"""Compat shim: ``AverageMeter`` moved into the telemetry subsystem
+(``jumbo_mae_tpu_tpu.obs.metrics``) so the log-window aggregation lives next
+to the registry the train loop exports through."""
 
-Equivalent of the reference's ``AverageMeter`` (``/root/reference/src/utils.py:36-52``):
-buffer per-step metric dicts, then emit prefixed means — except keys marked
-``use_latest`` (the live learning rate) which report their last value.
-"""
+from jumbo_mae_tpu_tpu.obs.metrics import AverageMeter
 
-from __future__ import annotations
-
-import numpy as np
-
-
-class AverageMeter:
-    def __init__(self, *, use_latest: tuple[str, ...] = ("learning_rate",)):
-        self.use_latest = set(use_latest)
-        self.buffer: dict[str, list[float]] = {}
-
-    def update(self, metrics: dict):
-        for k, v in metrics.items():
-            self.buffer.setdefault(k, []).append(float(np.asarray(v)))
-
-    def summary(self, prefix: str = "") -> dict[str, float]:
-        out = {}
-        for k, vals in self.buffer.items():
-            if not vals:
-                continue
-            value = vals[-1] if k in self.use_latest else float(np.mean(vals))
-            out[prefix + k] = value
-        self.buffer = {}
-        return out
+__all__ = ["AverageMeter"]

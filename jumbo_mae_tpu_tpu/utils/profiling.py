@@ -1,34 +1,7 @@
-"""Profiler capture helpers (the reference had none — SURVEY §5).
+"""Compat shim: profiler capture moved to ``jumbo_mae_tpu_tpu.obs.trace``,
+which adds host-side spans and chrome-trace export alongside the XLA
+device-trace helpers that lived here."""
 
-``trace(dir)`` wraps ``jax.profiler`` trace capture so any train loop can be
-profiled with one flag; traces open in XProf/TensorBoard and show the MXU
-utilization and HBM traffic the Pallas work is judged against.
-"""
+from jumbo_mae_tpu_tpu.obs.trace import annotate, trace
 
-from __future__ import annotations
-
-from contextlib import contextmanager
-
-
-@contextmanager
-def trace(log_dir: str | None):
-    """Capture a device trace into ``log_dir`` (no-op when None)."""
-    if not log_dir:
-        yield
-        return
-    import jax
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-@contextmanager
-def annotate(name: str):
-    """Named region in the trace timeline (``jax.profiler.TraceAnnotation``)."""
-    import jax
-
-    with jax.profiler.TraceAnnotation(name):
-        yield
+__all__ = ["annotate", "trace"]

@@ -1,0 +1,344 @@
+"""Telemetry subsystem contracts (jumbo_mae_tpu_tpu/obs).
+
+What the subsystem stands on:
+
+- the registry is exact under concurrent writers (serving threads all hit
+  the same counters/histograms);
+- histogram buckets follow Prometheus ``le`` semantics bit-exactly (a
+  scraper's histogram_quantile depends on it);
+- the text exposition is stable (golden) and parseable;
+- ``/metrics`` and ``/healthz`` work over a real socket, and health flips
+  with readiness/liveness;
+- spans aggregate into the registry and export chrome-trace JSON;
+- engine + micro-batcher traffic populates the serving metrics the
+  acceptance criteria name (request latency, batch occupancy, bucket-cache
+  hits/misses).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.obs import (
+    NULL_REGISTRY,
+    HealthState,
+    MetricsRegistry,
+    TelemetryServer,
+    get_registry,
+    set_registry,
+    span,
+)
+from jumbo_mae_tpu_tpu.obs.trace import (
+    export_chrome_trace,
+    span_timer,
+    start_chrome_trace,
+    stop_chrome_trace,
+)
+
+# ---------------------------------------------------------------- registry
+
+
+def test_counter_exact_under_threads():
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total", "x", labels=("who",))
+    h = reg.histogram("lat_seconds", buckets=(0.5, 1.0))
+    n_threads, n_incs = 8, 1000
+
+    def worker(i):
+        child = c.labels(str(i % 2))
+        for _ in range(n_incs):
+            child.inc()
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = c.labels("0").value + c.labels("1").value
+    assert total == n_threads * n_incs
+    assert h.count == n_threads * n_incs
+    assert h.sum == pytest.approx(0.25 * n_threads * n_incs)
+
+
+def test_histogram_bucket_edges():
+    reg = MetricsRegistry()
+    h = reg.histogram("h", buckets=(1.0, 2.0, 5.0))
+    # Prometheus le semantics: value == bound lands IN that bucket
+    for v in (0.5, 1.0, 1.5, 2.0, 5.0, 7.0):
+        h.observe(v)
+    cum = dict(h.cumulative())
+    assert cum[1.0] == 2  # 0.5, 1.0
+    assert cum[2.0] == 4  # + 1.5, 2.0
+    assert cum[5.0] == 5  # + 5.0
+    assert cum[float("inf")] == 6  # + 7.0
+    assert h.quantile(0.5) == 2.0
+    assert h.quantile(1.0) == float("inf")
+
+
+def test_histogram_rejects_unsorted_buckets():
+    with pytest.raises(ValueError):
+        MetricsRegistry().histogram("bad", buckets=(2.0, 1.0))
+
+
+def test_registry_type_and_label_conflicts():
+    reg = MetricsRegistry()
+    reg.counter("a_total")
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.gauge("a_total")
+    reg.counter("b_total", labels=("x",))
+    with pytest.raises(ValueError, match="labels"):
+        reg.counter("b_total", labels=("y",))
+    # re-registration with the same schema returns the same family
+    assert reg.counter("a_total") is reg.counter("a_total")
+
+
+def test_prometheus_golden_output():
+    reg = MetricsRegistry()
+    reg.counter("req_total", "requests served", labels=("task",)).labels(
+        "features"
+    ).inc(3)
+    reg.gauge("depth", "queue depth").set(2)
+    reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0)).observe(0.05)
+    assert reg.render() == (
+        "# HELP depth queue depth\n"
+        "# TYPE depth gauge\n"
+        "depth 2\n"
+        "# HELP lat_seconds latency\n"
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.1"} 1\n'
+        'lat_seconds_bucket{le="1"} 1\n'
+        'lat_seconds_bucket{le="+Inf"} 1\n'
+        "lat_seconds_sum 0.05\n"
+        "lat_seconds_count 1\n"
+        "# HELP req_total requests served\n"
+        "# TYPE req_total counter\n"
+        'req_total{task="features"} 3\n'
+    )
+
+
+def test_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("c_total", labels=("p",)).labels('a"b\\c\nd').inc()
+    assert 'c_total{p="a\\"b\\\\c\\nd"} 1' in reg.render()
+
+
+def test_null_registry_and_swap():
+    prev = set_registry(NULL_REGISTRY)
+    try:
+        c = get_registry().counter("dropped_total")
+        c.inc(100)
+        assert c.value == 0.0
+        assert get_registry().render() == ""
+    finally:
+        set_registry(prev)
+    # after restore, new handles record again
+    get_registry().counter("kept_total").inc()
+    assert get_registry().counter("kept_total").value >= 1
+
+
+# ---------------------------------------------------------------- exporter
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_exporter_metrics_and_healthz_over_socket():
+    reg = MetricsRegistry()
+    reg.counter("served_total", "x").inc(7)
+    health = HealthState()
+    with TelemetryServer(reg, health, host="127.0.0.1", port=0) as srv:
+        url = f"http://127.0.0.1:{srv.port}"
+        # not ready yet → 503 with a JSON body
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{url}/healthz", timeout=10)
+        assert e.value.code == 503
+        assert json.loads(e.value.read().decode())["ready"] is False
+
+        health.set_ready(True)
+        status, body = _get(f"{url}/healthz")
+        assert status == 200 and json.loads(body)["ok"] is True
+
+        status, body = _get(f"{url}/metrics")
+        assert status == 200
+        assert "served_total 7" in body
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{url}/nope", timeout=10)
+        assert e.value.code == 404
+
+
+def test_healthz_liveness_heartbeats():
+    health = HealthState(ready=True)
+    health.watch("step", max_age_s=0.2)
+    ok, report = health.report()
+    assert not ok  # watched but never beaten → not live
+    assert report["checks"]["step"]["age_s"] is None
+    health.beat("step")
+    ok, report = health.report()
+    assert ok and report["checks"]["step"]["ok"]
+    time.sleep(0.25)
+    ok, report = health.report()
+    assert not ok  # stale heartbeat
+    health.unwatch("step")
+    ok, _ = health.report()
+    assert ok
+
+
+# ------------------------------------------------------------------- spans
+
+
+def test_span_aggregates_into_registry():
+    reg = MetricsRegistry()
+    for _ in range(3):
+        with span("stage_a", registry=reg):
+            pass
+    snap = reg.snapshot()
+    assert snap["span_seconds"]["stage_a"]["count"] == 3
+    assert snap["span_seconds"]["stage_a"]["sum"] >= 0
+
+
+def test_span_timer_reuse_and_last_s():
+    reg = MetricsRegistry()
+    st = span_timer("loop", registry=reg)
+    with st:
+        time.sleep(0.01)
+    assert st.last_s >= 0.01
+    st.observe(0.5)
+    snap = reg.snapshot()["span_seconds"]["loop"]
+    assert snap["count"] == 2
+    assert snap["sum"] >= 0.51
+
+
+def test_chrome_trace_export(tmp_path):
+    reg = MetricsRegistry()
+    start_chrome_trace()
+    try:
+        with span("traced", registry=reg):
+            pass
+        path = export_chrome_trace(tmp_path / "trace.json")
+    finally:
+        stop_chrome_trace()
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    assert len(events) == 1
+    (evt,) = events
+    assert evt["name"] == "traced" and evt["ph"] == "X"
+    assert evt["dur"] >= 0 and "ts" in evt and "pid" in evt
+    # spans outside a capture window must not leak into a later export
+    with span("untraced", registry=reg):
+        pass
+
+
+# ------------------------------------------------------- compat shims
+
+
+def test_utils_shims_point_at_obs():
+    from jumbo_mae_tpu_tpu.obs import metrics as obs_metrics
+    from jumbo_mae_tpu_tpu.obs import mfu as obs_mfu
+    from jumbo_mae_tpu_tpu.utils import meters, mfu, profiling
+
+    assert meters.AverageMeter is obs_metrics.AverageMeter
+    assert mfu.mfu_report is obs_mfu.mfu_report
+    assert mfu.detect_peak_tflops is obs_mfu.detect_peak_tflops
+    from jumbo_mae_tpu_tpu.obs.trace import trace as obs_trace
+
+    assert profiling.trace is obs_trace
+
+
+# --------------------------------------------- engine integration (serve)
+
+
+@pytest.fixture(scope="module")
+def served():
+    """A tiny engine + micro-batcher driving real traffic into a fresh
+    registry; returns (registry, engine, batch_sizes)."""
+    from pathlib import Path
+
+    from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.infer import InferenceEngine, MicroBatcher
+
+    recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    cfg = load_config(
+        recipe,
+        [
+            "model.overrides.dtype=float32",
+            "model.dec_layers=1",
+            "model.dec_dim=32",
+            "model.dec_heads=2",
+            "model.dec_dtype=float32",
+        ],
+    )
+    reg = MetricsRegistry()
+    engine = InferenceEngine(cfg, max_batch=8, registry=reg)
+    images = (
+        np.random.RandomState(0).randint(0, 256, (24, 32, 32, 3)).astype(np.uint8)
+    )
+    with MicroBatcher(
+        lambda b: engine.features(b), max_batch=8, max_delay_ms=20.0,
+        registry=reg,
+    ) as mb:
+        futs = [mb.submit(img) for img in images]
+        rows = [f.result() for f in futs]
+        sizes = list(mb.batch_sizes)
+    assert len(rows) == 24
+    return reg, engine, sizes
+
+
+def test_engine_traffic_populates_serving_metrics(served):
+    reg, _, sizes = served
+    snap = reg.snapshot()
+    n_requests = 24
+    # request latency: one observation per submitted request
+    assert snap["infer_request_latency_seconds"][""]["count"] == n_requests
+    assert snap["infer_request_latency_seconds"][""]["sum"] > 0
+    # batch occupancy: one observation per flushed batch
+    assert snap["infer_batch_occupancy"][""]["count"] == len(sizes) > 0
+    assert snap["infer_requests_total"][""] == n_requests
+    assert snap["infer_batches_total"][""] == len(sizes)
+    # bucket-cache: first batch at each bucket compiles (miss), the rest hit
+    hits = sum(snap["infer_bucket_cache_hits_total"].values())
+    misses = sum(snap["infer_bucket_cache_misses_total"].values())
+    assert misses >= 1
+    assert hits + misses == len(sizes)
+    assert snap["infer_images_total"]["features"] == n_requests
+    assert snap["infer_predict_seconds"]["features"]["count"] == len(sizes)
+    assert snap["infer_compile_seconds"]["features:cls"]["count"] == misses
+
+
+def test_engine_metrics_render_for_scrape(served):
+    reg, _, _ = served
+    text = reg.render()
+    for needle in (
+        "infer_request_latency_seconds_bucket",
+        "infer_request_latency_seconds_count",
+        "infer_batch_occupancy_bucket",
+        "infer_bucket_cache_misses_total",
+        "infer_queue_depth",
+    ):
+        assert needle in text, f"{needle} missing from scrape"
+
+
+def test_batcher_error_counts_failed_requests():
+    from jumbo_mae_tpu_tpu.infer import MicroBatcher
+
+    reg = MetricsRegistry()
+
+    def boom(batch):
+        raise RuntimeError("kaput")
+
+    with MicroBatcher(boom, max_batch=4, max_delay_ms=1.0, registry=reg) as mb:
+        fut = mb.submit(np.zeros((2, 2, 3), np.uint8))
+        with pytest.raises(RuntimeError, match="kaput"):
+            fut.result(timeout=10)
+    snap = reg.snapshot()
+    assert snap["infer_requests_failed_total"][""] == 1
+    # no latency recorded for failed requests
+    assert snap["infer_request_latency_seconds"][""]["count"] == 0

@@ -350,6 +350,20 @@ def test_knn_probe_separates_clusters(tmp_path):
     assert acc > 0.9
 
 
+def test_knn_probe_rejects_empty_train_and_bad_k():
+    """An empty reference set (or k < 1) must die loudly — the silent
+    failure mode was class-0 predictions for every query (ADVICE r5)."""
+    from knn_probe import knn_predict
+
+    feats = np.ones((4, 8), np.float32)
+    labels = np.zeros((4,), np.int64)
+    query = np.ones((3, 8), np.float32)
+    with pytest.raises(SystemExit, match="empty"):
+        knn_predict(np.zeros((0, 8), np.float32), np.zeros((0,), int), query)
+    with pytest.raises(SystemExit, match="k must be"):
+        knn_predict(feats, labels, query, k=0)
+
+
 def test_extract_features_pools_and_ckpt_restore(tmp_path):
     """Shapes per pool mode; determinism; --ckpt actually changes the
     features (pretrain-tree 'encoder' subtree mapped onto the bare

@@ -63,6 +63,22 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--max-delay-ms", type=float, default=2.0)
     p.add_argument("--dtype", default=None, help="compute dtype override")
+    p.add_argument(
+        "--telemetry",
+        choices=("on", "off"),
+        default="on",
+        help="off swaps the default registry for the no-op NullRegistry "
+        "before any engine/batcher construction — the A/B leg PERF.md's "
+        "exporter-overhead number comes from",
+    )
+    p.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="expose /metrics + /healthz during the bench (0 = any free "
+        "port); the final scrape is summarized into the JSON report",
+    )
     p.add_argument("--naive-requests", type=int, default=0,
                    help="naive-leg stream length (default: min(requests, 128); "
                    "the serial leg is slow by construction)")
@@ -100,6 +116,15 @@ def main(argv: list[str] | None = None) -> dict:
 
     from jumbo_mae_tpu_tpu.config import load_config
     from jumbo_mae_tpu_tpu.infer import InferenceEngine, MicroBatcher
+    from jumbo_mae_tpu_tpu.obs import NULL_REGISTRY, TelemetryServer, set_registry
+
+    if args.telemetry == "off":
+        # must happen before the engine/batcher resolve their handles
+        set_registry(NULL_REGISTRY)
+    telemetry = None
+    if args.metrics_port is not None:
+        telemetry = TelemetryServer(port=args.metrics_port).start()
+        print(f"[bench] exporter on :{telemetry.port}", file=sys.stderr)
 
     recipe = args.recipe
     overrides = list(args.overrides)
@@ -212,10 +237,31 @@ def main(argv: list[str] | None = None) -> dict:
         "max_batch": args.max_batch,
         "max_delay_ms": args.max_delay_ms,
         "clients": args.clients,
+        "telemetry": args.telemetry,
         "naive": naive,
         "engine": eng,
         "speedup": round(eng["imgs_per_sec"] / naive["imgs_per_sec"], 2),
     }
+    if telemetry is not None:
+        # scrape over the real socket — the same path an external Prometheus
+        # takes — and record proof-of-life in the report
+        from urllib.request import urlopen
+
+        with urlopen(
+            f"http://127.0.0.1:{telemetry.port}/metrics", timeout=10
+        ) as resp:
+            scrape = resp.read().decode()
+        keys = (
+            "infer_request_latency_seconds",
+            "infer_batch_occupancy",
+            "infer_bucket_cache_hits_total",
+            "infer_bucket_cache_misses_total",
+        )
+        report["metrics"] = {
+            "scrape_lines": len(scrape.splitlines()),
+            "families_seen": [k for k in keys if k in scrape],
+        }
+        telemetry.close()
     line = json.dumps(report)
     print(line)
     if args.out:

@@ -70,8 +70,29 @@ def extract_arrays(cfg, ckpt: str, pool: str):
     # the recipe's label count — synthetic-data label export must match the
     # recipe's class space (the engine forces its own encoder headless)
     recipe_labels = cfg.model.overrides.get("labels")
+    if cfg.mesh.pipe > 1:
+        # a pipeline mesh only exists for the training step; the extraction
+        # stream just needs batches sharded over the devices — flatten to
+        # the default data×fsdp mesh instead of failing in create_mesh
+        import dataclasses
+
+        print(
+            f"[extract] NOTE: recipe requests mesh.pipe={cfg.mesh.pipe}; "
+            "extraction has no pipeline stage — flattening to a data mesh"
+        )
+        cfg = dataclasses.replace(
+            cfg,
+            mesh=dataclasses.replace(
+                cfg.mesh, pipe=1, pipe_microbatches=0, pipe_decoder=False
+            ),
+        )
     mesh = create_mesh(cfg.mesh)
-    per_batch = max(1, cfg.run.valid_batch_size)
+    # the device-prefetch sharding needs the batch divisible by the mesh's
+    # data axes — round up to the device count (same rule as reconstruct.py;
+    # a recipe batch of e.g. 6 on 4 devices previously died in an opaque
+    # sharding error)
+    n_dev = len(jax.devices())
+    per_batch = -(-max(1, cfg.run.valid_batch_size) // n_dev) * n_dev
     engine = InferenceEngine(
         cfg, ckpt=ckpt, max_batch=bucket_for(per_batch, 1024)
     )

@@ -56,6 +56,15 @@ def knn_predict(
 
     train = l2norm(train_feats)
     query = l2norm(query_feats)
+    if train.shape[0] == 0:
+        # k would clamp to 0 and the [:, :0] slice below silently votes
+        # class 0 for every query — a 0.1%-accuracy "result" from a bug
+        raise SystemExit(
+            "kNN probe: train feature set is empty — re-extract the "
+            "reference split (check data.valid_shards / synthetic count)"
+        )
+    if k < 1:
+        raise SystemExit(f"kNN probe: k must be >= 1, got {k}")
     labels = np.asarray(train_labels)
     classes = int(num_classes or labels.max() + 1)
     k = min(k, train.shape[0])
