@@ -62,6 +62,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--max-delay-ms", type=float, default=5.0, help="--serve coalescing deadline"
     )
     p.add_argument(
+        "--max-queue",
+        type=int,
+        default=None,
+        metavar="N",
+        help="--serve backpressure bound: submits beyond N pending "
+        "requests shed with QueueFullError (default: unbounded)",
+    )
+    p.add_argument(
+        "--deadline-ms",
+        type=float,
+        default=None,
+        metavar="MS",
+        help="--serve per-request deadline: a request still queued after "
+        "MS fails with DeadlineExceededError instead of riding a batch",
+    )
+    p.add_argument(
         "--serve",
         action="store_true",
         help="submit images one-by-one through the micro-batching queue",
@@ -171,8 +187,15 @@ def main(argv: list[str] | None = None) -> Path | None:
             run_fn,
             max_batch=args.max_batch,
             max_delay_ms=args.max_delay_ms,
+            max_queue=args.max_queue,
         ) as mb:
-            rows = [f.result() for f in [mb.submit(img) for img in images]]
+            rows = [
+                f.result()
+                for f in [
+                    mb.submit(img, deadline_ms=args.deadline_ms)
+                    for img in images
+                ]
+            ]
         out = (
             {k: np.stack([r[k] for r in rows]) for k in rows[0]}
             if isinstance(rows[0], dict)

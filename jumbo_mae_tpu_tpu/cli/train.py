@@ -37,6 +37,15 @@ from jumbo_mae_tpu_tpu.data import (
     synthetic_batches,
     valid_loader,
 )
+from jumbo_mae_tpu_tpu.data.tario import QUARANTINE
+from jumbo_mae_tpu_tpu.faults import (
+    DivergenceError,
+    DivergenceSentinel,
+    SentinelConfig,
+    fault_point,
+    faults_active,
+    install_plan,
+)
 from jumbo_mae_tpu_tpu.models import (
     ClassificationModel,
     DecoderConfig,
@@ -398,6 +407,11 @@ def evaluate(eval_step, state, batches, pad_batch: dict | None = None) -> dict[s
 def train(cfg: TrainConfig) -> dict:
     """Run the configured job; returns the final summary metrics."""
     run = cfg.run
+    if run.faults:
+        # recipe-driven chaos: the plan outlives this call on purpose (the
+        # GRAFT_FAULTS env path behaves the same) — tests clear it
+        plan = install_plan(run.faults)
+        print(f"[faults] injection plan active: sites={plan.sites()}")
     process_count = jax.process_count()
     if run.train_batch_size % (process_count * run.grad_accum):
         raise ValueError(
@@ -579,6 +593,7 @@ def train(cfg: TrainConfig) -> dict:
             pipe_microbatches=pipe_microbatches,
             encoder_cfg=enc_cfg if pipe_microbatches else None,
             decoder_cfg=dec_cfg,
+            guard_nonfinite=run.sentinel,
         )
     )
     eval_step = make_eval_step(mesh, state_sharding, mode=mode_key)
@@ -598,6 +613,10 @@ def train(cfg: TrainConfig) -> dict:
     # (if requested) restored by this point, so readiness is honest.
     health = HealthState()
     health.set_ready(True, detail=f"mode={run.mode} start_step={start_step}")
+    # data-layer resilience surfaced to the operator: shard URLs the retry
+    # layer gave up on this process (worker subprocesses keep their own —
+    # the inline and native-IO substrates report here)
+    health.probe("quarantined_shards", lambda: sorted(QUARANTINE.snapshot()))
     telemetry = None
     if run.telemetry and is_main:
         telemetry = TelemetryServer(
@@ -667,6 +686,21 @@ def train(cfg: TrainConfig) -> dict:
     timer = StepTimer(warmup_steps=min(2, max(1, run.training_steps - 1)))
     n_chips = len(jax.devices())
     last_metrics: dict[str, float] = {}
+    # divergence sentinel (faults/sentinel.py): the device guard inside the
+    # step skips non-finite updates; this host half watches the fetched
+    # metrics for bad streaks and drives rollback-to-last-checkpoint
+    sentinel = (
+        DivergenceSentinel(
+            SentinelConfig(
+                patience=run.sentinel_patience,
+                spike_factor=run.sentinel_spike_factor,
+                ema_beta=run.sentinel_ema_beta,
+                max_rollbacks=run.sentinel_max_rollbacks,
+            )
+        )
+        if run.sentinel
+        else None
+    )
 
     # step-loop telemetry: spans aggregate into span_seconds{name=...}; the
     # gauges publish the log-window derived numbers the logger prints.
@@ -692,18 +726,32 @@ def train(cfg: TrainConfig) -> dict:
     window_t0, window_wait = time.perf_counter(), 0.0
 
     with trace(run.profile_dir or None):
-        pending: list = []
-        for step in range(start_step + 1, run.training_steps + 1):
+        pending: list = []  # [(step, device-metrics)] fetched at log time
+        step = start_step
+        while step < run.training_steps:
+            step += 1
             with sp_wait:
                 batch = next(train_iter)
             window_wait += sp_wait.last_s
             health.beat("data_batch")
+            # fault sites train.loss / train.grad: traced multipliers into
+            # the step (NaN at chosen invocations, no recompile); the
+            # branch costs nothing when no plan is active
+            inject = None
+            if faults_active():
+                lm = fault_point("train.loss", key=str(step), data=1.0)
+                gm = fault_point("train.grad", key=str(step), data=1.0)
+                if (lm, gm) != (1.0, 1.0):
+                    inject = np.asarray([lm, gm], np.float32)
             with sp_step:
-                state, metrics = train_step(state, batch)
+                if inject is None:
+                    state, metrics = train_step(state, batch)
+                else:
+                    state, metrics = train_step(state, batch, inject)
             c_steps.inc()
             g_step.set(step)
             health.beat("train_step")
-            pending.append(metrics)  # device arrays; fetched at log time
+            pending.append((step, metrics))
             timer.tick()
             # only cursor_log[step] (and prefetched future steps) are ever
             # read — prune dead entries every iteration, not just at save
@@ -714,8 +762,18 @@ def train(cfg: TrainConfig) -> dict:
             if step % run.log_interval == 0 or step == run.training_steps:
                 # sync ONLY at log boundaries — per-step device_get/block
                 # would serialize host dispatch against device compute
-                for m in jax.device_get(pending):
-                    meter.update(m)
+                want_rollback = False
+                for (s, m) in zip(
+                    (s for s, _ in pending),
+                    jax.device_get([m for _, m in pending]),
+                ):
+                    skipped = float(m.get("skipped", 0.0)) >= 0.5
+                    if sentinel is not None and sentinel.observe(s, m):
+                        want_rollback = True
+                    if not skipped:
+                        # a skipped step's loss is the garbage the guard
+                        # refused to apply — keep it out of the log means
+                        meter.update(m)
                 pending.clear()
                 summary = meter.summary("train/")
                 sps = timer.steps_per_sec
@@ -735,6 +793,34 @@ def train(cfg: TrainConfig) -> dict:
                 window_t0, window_wait = now, 0.0
                 logger.log(summary, step=step)
                 last_metrics = summary
+
+                if want_rollback:
+                    # persistent divergence: restore the last checkpoint
+                    # (params + optimizer + RNG + data cursor) and continue
+                    # from there. Skipping alone can't fix a state that is
+                    # already bad — rewinding to a known-good one can.
+                    if ckpt.latest_step("last") is None:
+                        raise DivergenceError(
+                            f"training diverged at step {step} with no "
+                            "checkpoint to roll back to — lower the LR or "
+                            "set run.eval_interval below the failure point"
+                        )
+                    sentinel.record_rollback()  # raises once budget is spent
+                    ckpt.wait()  # a save may still be in flight
+                    state, extra = ckpt.restore(state, sharding=state_sharding)
+                    step = int(state.step)
+                    print(
+                        f"[train] sentinel rollback #{sentinel.rollbacks} → "
+                        f"resuming from step {step}"
+                    )
+                    if source is not None:
+                        source.close()
+                    train_iter, source, cursor_log = make_train_iterator(
+                        cfg, mesh, per_process, step,
+                        extra.get("data_cursor"),
+                        num_labels=enc_cfg.labels or 1000,
+                    )
+                    continue
 
             saved_this_step = False
             if step % run.eval_interval == 0 or step == run.training_steps:

@@ -84,6 +84,19 @@ class RunConfig:
     resume: bool = False
     pretrained_ckpt: str = ""
     profile_dir: str = ""
+    # resilience (jumbo_mae_tpu_tpu/faults): the divergence sentinel skips
+    # non-finite steps on device and, after sentinel_patience consecutive
+    # bad steps (skips or loss spikes above sentinel_spike_factor x EMA),
+    # rolls back to the last checkpoint with the data cursor restored —
+    # giving up after sentinel_max_rollbacks. `faults` holds a fault-
+    # injection plan (GRAFT_FAULTS grammar, see faults/inject.py) — chaos
+    # testing only; empty means the env var (if any) stays in charge.
+    sentinel: bool = True
+    sentinel_patience: int = 3
+    sentinel_spike_factor: float = 10.0
+    sentinel_ema_beta: float = 0.98
+    sentinel_max_rollbacks: int = 3
+    faults: str = ""
     # telemetry (jumbo_mae_tpu_tpu/obs): metrics are always *recorded*; the
     # exporter serving them over HTTP (/metrics Prometheus text, /healthz)
     # is opt-in. Port 0 binds any free port (the chosen one is printed).

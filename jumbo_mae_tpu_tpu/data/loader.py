@@ -32,10 +32,11 @@ from pathlib import Path
 import numpy as np
 
 from jumbo_mae_tpu_tpu.data.decode import decode_image, decode_label, find_image_key
+from jumbo_mae_tpu_tpu.faults.inject import fault_point
 from jumbo_mae_tpu_tpu.obs.metrics import get_registry
 from jumbo_mae_tpu_tpu.data.randaugment import auto_augment_factory
 from jumbo_mae_tpu_tpu.data.shards import expand_shards, shuffle_shards, split_shards
-from jumbo_mae_tpu_tpu.data.tario import iter_shards_samples
+from jumbo_mae_tpu_tpu.data.tario import RetryPolicy, iter_shards_samples
 from jumbo_mae_tpu_tpu.data.transforms import (
     color_jitter,
     eval_transform,
@@ -67,6 +68,12 @@ class DataConfig:
     seed: int = 0
     workers: int = 4
     prefetch_batches: int = 4
+    # shard-read resilience (data/tario.py): transient OSError/pipe failures
+    # get shard_retries attempts with capped exponential backoff (base
+    # shard_retry_backoff_s, jittered) before the shard is quarantined for
+    # the rest of the epoch pass (counted + surfaced in /healthz)
+    shard_retries: int = 3
+    shard_retry_backoff_s: float = 0.05
     # samples per epoch — used only to convert a resumed step count into the
     # stream's starting epoch (coarse data-cursor resume)
     dataset_size: int = 1_281_167
@@ -94,6 +101,14 @@ class StreamCursor:
 
     epoch: int = 0
     offset: int = 0
+
+
+def _retry_policy(cfg: DataConfig) -> RetryPolicy:
+    """The shard-read retry policy every stream in this module uses."""
+    return RetryPolicy(
+        attempts=max(1, cfg.shard_retries),
+        backoff_s=max(0.0, cfg.shard_retry_backoff_s),
+    )
 
 
 def _aug_rng(
@@ -183,9 +198,14 @@ def train_sample_stream(
     # per-sample decode time — in a worker subprocess this lands in that
     # process's own registry (unexported), in the inline/native path it
     # feeds the exporter directly
-    m_decode = get_registry().histogram(
+    reg = get_registry()
+    m_decode = reg.histogram(
         "data_decode_seconds", "image decode time per sample"
     )
+    m_decode_fail = reg.counter(
+        "data_decode_failures_total", "samples dropped by a failed decode"
+    )
+    retry = _retry_policy(cfg)
     epoch = start_epoch
     to_skip = max(0, skip_samples)
     while True:
@@ -201,14 +221,20 @@ def train_sample_stream(
         )
 
         def decoded():
-            for sample in iter_shards_samples(epoch_shards):
+            for sample in iter_shards_samples(epoch_shards, retry=retry):
                 img_key = find_image_key(sample)
                 if img_key is None:
                     continue
                 t0 = time.perf_counter()
-                img = decode_image(sample[img_key])  # type: ignore[arg-type]
+                payload = fault_point(
+                    "data.decode",
+                    key=str(sample.get("__key__", "")),
+                    data=sample[img_key],
+                )
+                img = decode_image(payload)  # type: ignore[arg-type]
                 m_decode.observe(time.perf_counter() - t0)
                 if img is None:
+                    m_decode_fail.inc()
                     continue
                 label = decode_label(sample["cls"]) if "cls" in sample else -1
                 yield img, label
@@ -238,7 +264,7 @@ def valid_sample_stream(
         process_index=process_index,
         process_count=process_count,
     )
-    for sample in iter_shards_samples(shards):
+    for sample in iter_shards_samples(shards, retry=_retry_policy(cfg)):
         img_key = find_image_key(sample)
         if img_key is None:
             continue
@@ -278,8 +304,12 @@ def native_train_stream(
 
     shards = expand_shards(cfg.train_shards)
     transform = TrainTransform(cfg)
-    m_decode = get_registry().histogram(
+    reg = get_registry()
+    m_decode = reg.histogram(
         "data_decode_seconds", "image decode time per sample"
+    )
+    m_decode_fail = reg.counter(
+        "data_decode_failures_total", "samples dropped by a failed decode"
     )
     epoch = start_epoch
     to_skip = max(0, skip_samples)
@@ -295,9 +325,13 @@ def native_train_stream(
             def decode_one(pair):
                 payload, label = pair
                 t0 = time.perf_counter()
+                payload = fault_point("data.decode", data=payload)
                 img = decode_image(payload)
                 m_decode.observe(time.perf_counter() - t0)
-                return None if img is None else (img, label)
+                if img is None:
+                    m_decode_fail.inc()
+                    return None
+                return (img, label)
 
             def decoded(reader):
                 # bounded in-flight futures (NOT pool.map, which eagerly

@@ -16,22 +16,96 @@ Supported URL schemes (both read and write):
 - ``http(s)://`` — urllib streaming read.
 
 Corrupt tar members or truncated archives are skipped with a warning, the
-reference's ``ignore_and_continue`` policy.
+reference's ``ignore_and_continue`` policy — but no longer *silently*: both
+are counted in the obs registry (``data_corrupt_members_total``,
+``data_truncated_shards_total``), and shard-level read failures now get
+**retries with capped exponential backoff** (transient GCS/pipe blips heal
+in place, resuming exactly past the samples already yielded) before the
+shard is **quarantined** for the rest of the pass — logged, counted
+(``data_shards_quarantined_total``), and surfaced through ``/healthz`` —
+instead of being dropped on the first error.
 """
 
 from __future__ import annotations
 
 import io
 import logging
+import random
 import subprocess
 import tarfile
+import threading
+import time
 from collections.abc import Iterator
 from contextlib import contextmanager
+from dataclasses import dataclass
 from urllib.parse import urlparse
+
+from jumbo_mae_tpu_tpu.faults.inject import fault_point
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
 
 logger = logging.getLogger(__name__)
 
 Sample = dict[str, bytes | str]
+
+
+class TruncatedShardError(OSError):
+    """A tar stream ended mid-archive. OSError subclass on purpose: the
+    retry loop treats truncation as transient (a cut network read and a
+    truncated file at rest are indistinguishable from here)."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Shard-read retry knobs (``data.shard_retries`` /
+    ``data.shard_retry_backoff_s`` in recipes)."""
+
+    attempts: int = 3        # total tries per shard per pass
+    backoff_s: float = 0.05  # first sleep; doubles per retry
+    max_backoff_s: float = 5.0
+    jitter: float = 0.25     # +- fraction of the sleep, decorrelates workers
+
+    def sleep_s(self, retry_index: int, rng: random.Random) -> float:
+        base = min(self.backoff_s * (2.0 ** retry_index), self.max_backoff_s)
+        return base * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
+
+
+class ShardQuarantine:
+    """Process-global record of shards given up on (after retries).
+
+    The *skip* decision is per-pass — each epoch retries a previously bad
+    shard, so a healed store heals the stream — but the record accumulates
+    for observability: ``snapshot()`` feeds the ``/healthz`` probe wired by
+    ``cli/train.py``. Worker subprocesses keep their own instance (their
+    registries are per-process too); the inline and native-IO paths feed
+    the exporter directly.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: dict[str, str] = {}
+
+    def add(self, url: str, reason: str) -> None:
+        with self._lock:
+            self._items[url] = reason
+        get_registry().counter(
+            "data_shards_quarantined_total",
+            "shards abandoned after exhausting read retries",
+        ).inc()
+
+    def snapshot(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._items)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._items.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+QUARANTINE = ShardQuarantine()
 
 
 @contextmanager
@@ -82,8 +156,17 @@ def shell_quote(s: str) -> str:
     return shlex.quote(s)
 
 
-def iter_tar(stream) -> Iterator[tuple[str, bytes]]:
-    """Yield (member_name, payload) from a non-seekable tar stream."""
+def iter_tar(stream, *, strict: bool = False) -> Iterator[tuple[str, bytes]]:
+    """Yield (member_name, payload) from a non-seekable tar stream.
+
+    Corrupt members are skipped and counted; a truncated archive stops the
+    shard and is counted — and with ``strict=True`` additionally raises
+    :class:`TruncatedShardError` so the retry layer can re-read the shard
+    (a truncated *network read* heals on retry; a truncated file at rest
+    exhausts the attempts and quarantines, same net data as before but
+    visible on ``/metrics`` instead of a log line nobody reads).
+    """
+    reg = get_registry()
     try:
         with tarfile.open(fileobj=stream, mode="r|*") as tar:
             for member in tar:
@@ -95,9 +178,19 @@ def iter_tar(stream) -> Iterator[tuple[str, bytes]]:
                 try:
                     yield member.name, f.read()
                 except tarfile.TarError as e:  # corrupt member: skip
+                    reg.counter(
+                        "data_corrupt_members_total",
+                        "corrupt tar members skipped",
+                    ).inc()
                     logger.warning("skipping corrupt member %s: %s", member.name, e)
     except tarfile.TarError as e:  # truncated archive: stop this shard
+        reg.counter(
+            "data_truncated_shards_total",
+            "tar streams that ended mid-archive",
+        ).inc()
         logger.warning("truncated/corrupt tar stream: %s", e)
+        if strict:
+            raise TruncatedShardError(str(e)) from e
 
 
 def _split_member(name: str) -> tuple[str, str]:
@@ -126,20 +219,75 @@ def group_samples(members: Iterator[tuple[str, bytes]]) -> Iterator[Sample]:
         yield current
 
 
-def iter_tar_samples(url: str) -> Iterator[Sample]:
-    """Stream one shard URL as grouped samples; never raises on bad data."""
-    try:
-        with open_url(url) as stream:
-            yield from group_samples(iter_tar(stream))
-    except (OSError, RuntimeError) as e:
-        logger.warning("skipping unreadable shard %s: %s", url, e)
+def iter_tar_samples(
+    url: str, retry: RetryPolicy | None = None
+) -> Iterator[Sample]:
+    """Stream one shard URL as grouped samples; never raises on bad data.
+
+    Transient read failures (``OSError`` — including truncation under
+    ``strict`` tar reading — and pipe ``RuntimeError``) are retried with
+    capped, jittered exponential backoff. A retry **re-reads the shard and
+    resumes exactly past the samples already yielded** (tar order is
+    deterministic), so a shard that fails twice then succeeds contributes
+    the identical sample sequence as a fault-free read. When every attempt
+    fails the shard is recorded in :data:`QUARANTINE` and the stream moves
+    on — the epoch survives, the loss is visible on ``/metrics``.
+    """
+    policy = retry or RetryPolicy()
+    rng = random.Random(url)  # str seeds hash-randomization-free (sha512)
+    yielded = 0
+    closing = False
+    last_err: BaseException | None = None
+    for attempt in range(max(1, policy.attempts)):
+        try:
+            fault_point("data.shard_open", key=url)
+            with open_url(url) as stream:
+                for i, sample in enumerate(
+                    group_samples(iter_tar(stream, strict=True))
+                ):
+                    if i < yielded:  # replay of an already-consumed prefix
+                        continue
+                    yielded += 1
+                    try:
+                        yield sample
+                    except GeneratorExit:
+                        # consumer closed us mid-shard — pipe teardown may
+                        # surface as RuntimeError below; not a read failure
+                        closing = True
+                        raise
+            return
+        except (OSError, RuntimeError) as e:
+            if closing:
+                return
+            last_err = e
+            if attempt + 1 >= max(1, policy.attempts):
+                break
+            get_registry().counter(
+                "data_shard_retries_total",
+                "shard reads retried after a transient failure",
+            ).inc()
+            delay = policy.sleep_s(attempt, rng)
+            logger.warning(
+                "shard %s read failed (attempt %d/%d): %s — retrying in %.2fs",
+                url, attempt + 1, policy.attempts, e, delay,
+            )
+            time.sleep(delay)
+    logger.error(
+        "quarantining shard %s after %d attempts: %s",
+        url, policy.attempts, last_err,
+    )
+    QUARANTINE.add(url, f"{type(last_err).__name__}: {last_err}")
 
 
-def iter_shards_samples(urls: list[str]) -> Iterator[Sample]:
+def iter_shards_samples(
+    urls: list[str], retry: RetryPolicy | None = None
+) -> Iterator[Sample]:
     """Stream several shards back to back, tagging each sample with its
-    ``__url__`` (useful for resume diagnostics)."""
+    ``__url__`` (useful for resume diagnostics). A shard that exhausts its
+    read retries is skipped (quarantined for this pass); the remaining
+    shards still stream — one bad shard never kills the epoch."""
     for url in urls:
-        for sample in iter_tar_samples(url):
+        for sample in iter_tar_samples(url, retry=retry):
             sample["__url__"] = url
             yield sample
 

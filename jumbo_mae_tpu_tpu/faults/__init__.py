@@ -1,0 +1,37 @@
+"""Resilience layer: deterministic fault injection + divergence sentinel.
+
+- ``faults.inject``   — named fault sites driven by a seeded plan
+  (``GRAFT_FAULTS`` env / ``run.faults`` recipe key); no-op when unset.
+- ``faults.sentinel`` — on-device non-finite step guard and the host-side
+  divergence sentinel (skip / EMA spike / rollback policy).
+"""
+
+from jumbo_mae_tpu_tpu.faults.inject import (
+    FaultPlan,
+    FaultRule,
+    active_plan,
+    clear_plan,
+    fault_point,
+    faults_active,
+    install_plan,
+)
+from jumbo_mae_tpu_tpu.faults.sentinel import (
+    DivergenceError,
+    DivergenceSentinel,
+    SentinelConfig,
+    guarded_apply_gradients,
+)
+
+__all__ = [
+    "DivergenceError",
+    "DivergenceSentinel",
+    "FaultPlan",
+    "FaultRule",
+    "SentinelConfig",
+    "active_plan",
+    "clear_plan",
+    "fault_point",
+    "faults_active",
+    "guarded_apply_gradients",
+    "install_plan",
+]

@@ -1,0 +1,296 @@
+"""Deterministic fault injection: a seeded plan of named failure sites.
+
+Long pretraining runs die for reasons the happy path never exercises — a
+transient GCS read error, a NaN loss, a wedged checkpoint write, serving
+overload. This module makes those failures *first-class inputs*: code
+declares named sites (``fault_point("data.shard_open", key=url)``) and a
+**fault plan** — parsed from the ``GRAFT_FAULTS`` env var or the
+``run.faults`` recipe key — decides, deterministically, which invocations
+fail and how. The chaos suite (``tests/test_chaos.py``) drives every
+recovery path in the repo through these hooks; production runs pay one
+module-global load + ``None`` check per site.
+
+Plan grammar (rules separated by ``;``)::
+
+    rule    = site ':' action [ '(' arg ')' ] [ '@' sel (',' sel)* ]
+    action  = 'raise'   [ '(' ExcName ')' ]    -- raise (default OSError)
+            | 'delay'   '(' seconds ')'        -- time.sleep
+            | 'corrupt' [ '(' nbytes ')' ]     -- flip bytes in the payload
+            | 'nan'                            -- replace the value with NaN
+    sel     = 'n=' A [ '..' B ]   -- rule-local invocation index (0-based,
+                                     inclusive range)
+            | 'n<' N              -- first N invocations
+            | 'n%' K '=' R        -- every K-th invocation with remainder R
+            | 'p=' F              -- seeded Bernoulli(F) per invocation
+            | 'key~' SUBSTR       -- only when the site key contains SUBSTR
+    seed    = 'seed=' N           -- standalone rule: seeds every 'p=' draw
+
+All selectors of a rule must match for it to fire. Examples::
+
+    data.shard_open:raise(OSError)@n<2            # first two opens fail
+    train.loss:nan@n=4..6                         # NaN loss at calls 4-6
+    data.shard_open:raise@key~shard-0003          # one shard always fails
+    serve.submit:delay(0.05)@n%10=0               # every 10th submit is slow
+    seed=7;data.decode:corrupt(4)@p=0.01          # 1% of decodes corrupted
+
+Known sites (free-form names are allowed; these are the wired ones):
+``data.shard_open``, ``data.decode``, ``train.loss``, ``train.grad``,
+``serve.submit``, ``ckpt.save``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+# Exception classes `raise(Name)` may name — a closed set, so a fault plan
+# can never be used to execute arbitrary attribute lookups.
+_EXCEPTIONS = {
+    "OSError": OSError,
+    "IOError": OSError,
+    "ConnectionError": ConnectionError,
+    "TimeoutError": TimeoutError,
+    "RuntimeError": RuntimeError,
+    "ValueError": ValueError,
+    "MemoryError": MemoryError,
+}
+
+_ACTIONS = ("raise", "delay", "corrupt", "nan")
+
+
+class FaultInjected(RuntimeError):
+    """Default marker mixin-free exception is OSError; this name is only
+    used in reprs/logs when a rule raises without naming a class."""
+
+
+@dataclass
+class FaultRule:
+    site: str
+    action: str
+    arg: str | float | None = None
+    selectors: list[tuple[str, object]] = field(default_factory=list)
+    calls: int = 0  # invocations of the site seen by THIS rule
+    hits: int = 0   # invocations this rule actually fired on
+
+    def matches(self, key: str | None, rng) -> bool:
+        n = self.calls
+        for kind, val in self.selectors:
+            if kind == "n=":
+                lo, hi = val
+                if not (lo <= n <= hi):
+                    return False
+            elif kind == "n<":
+                if not n < val:
+                    return False
+            elif kind == "n%":
+                k, r = val
+                if n % k != r:
+                    return False
+            elif kind == "p=":
+                # one seeded draw per invocation, keyed on (rule, n) so the
+                # outcome is independent of call interleaving across sites
+                if rng.random() >= val:
+                    return False
+            elif kind == "key~":
+                if key is None or val not in str(key):
+                    return False
+        return True
+
+
+def _parse_selector(text: str) -> tuple[str, object]:
+    text = text.strip()
+    if text.startswith("key~"):
+        return ("key~", text[len("key~"):])
+    if text.startswith("p="):
+        p = float(text[2:])
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"p= selector must be in [0,1], got {text!r}")
+        return ("p=", p)
+    if text.startswith("n<"):
+        return ("n<", int(text[2:]))
+    if text.startswith("n%"):
+        mod, _, rem = text[2:].partition("=")
+        if not rem:
+            raise ValueError(f"n%% selector needs K=R, got {text!r}")
+        return ("n%", (int(mod), int(rem)))
+    if text.startswith("n="):
+        lo, sep, hi = text[2:].partition("..")
+        return ("n=", (int(lo), int(hi) if sep else int(lo)))
+    raise ValueError(f"unknown fault selector {text!r}")
+
+
+def _parse_rule(text: str) -> FaultRule:
+    head, _, sel = text.partition("@")
+    site, colon, act = head.partition(":")
+    if not colon or not site.strip():
+        raise ValueError(f"fault rule needs site:action, got {text!r}")
+    act = act.strip()
+    arg: str | float | None = None
+    if "(" in act:
+        if not act.endswith(")"):
+            raise ValueError(f"unbalanced '(' in fault action {act!r}")
+        act, _, raw = act[:-1].partition("(")
+        arg = raw.strip()
+    if act not in _ACTIONS:
+        raise ValueError(f"unknown fault action {act!r} (one of {_ACTIONS})")
+    if act == "delay":
+        arg = float(arg) if arg else 0.01
+    elif act == "corrupt":
+        arg = int(arg) if arg else 8
+    elif act == "raise" and arg and arg not in _EXCEPTIONS:
+        raise ValueError(
+            f"raise({arg}) not allowed; choose from {sorted(_EXCEPTIONS)}"
+        )
+    selectors = [_parse_selector(s) for s in sel.split(",") if s.strip()] if sel else []
+    return FaultRule(site=site.strip(), action=act, arg=arg, selectors=selectors)
+
+
+class FaultPlan:
+    """A parsed set of rules, grouped by site, with deterministic firing.
+
+    All mutable state (per-rule counters, the Bernoulli stream) is guarded
+    by one lock — sites like ``serve.submit`` fire from many threads.
+    """
+
+    def __init__(self, rules: list[FaultRule], *, seed: int = 0, text: str = ""):
+        import random
+
+        self.text = text
+        self.seed = seed
+        self._by_site: dict[str, list[FaultRule]] = {}
+        for r in rules:
+            self._by_site.setdefault(r.site, []).append(r)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        reg = get_registry()
+        self._m_injected = reg.counter(
+            "faults_injected_total",
+            "faults fired by the active injection plan",
+            labels=("site", "action"),
+        )
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        seed = 0
+        rules = []
+        for part in text.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if part.startswith("seed="):
+                seed = int(part[len("seed="):])
+                continue
+            rules.append(_parse_rule(part))
+        return cls(rules, seed=seed, text=text)
+
+    def sites(self) -> list[str]:
+        return sorted(self._by_site)
+
+    def counts(self) -> dict[str, tuple[int, int]]:
+        """{'site:action' → (calls, hits)} — test/debug readout."""
+        with self._lock:
+            return {
+                f"{r.site}:{r.action}": (r.calls, r.hits)
+                for rs in self._by_site.values()
+                for r in rs
+            }
+
+    def fire(self, site: str, key: str | None, data):
+        """Apply the first matching rule for ``site``; returns the (possibly
+        replaced) ``data``. Raise/delay actions happen here."""
+        rules = self._by_site.get(site)
+        if not rules:
+            return data
+        with self._lock:
+            fired = None
+            for r in rules:
+                if fired is None and r.matches(key, self._rng):
+                    fired = r
+                    r.hits += 1
+                r.calls += 1
+            if fired is None:
+                return data
+            self._m_injected.labels(site, fired.action).inc()
+        # side effects OUTSIDE the lock — a delay must not serialize other
+        # sites, and a raised exception must not poison the lock
+        if fired.action == "raise":
+            exc = _EXCEPTIONS.get(str(fired.arg) or "", OSError)
+            raise exc(
+                f"fault injected at {site} (rule {fired.site}:{fired.action}"
+                f"{f'({fired.arg})' if fired.arg else ''})"
+            )
+        if fired.action == "delay":
+            time.sleep(float(fired.arg))
+            return data
+        if fired.action == "corrupt":
+            return _corrupt_bytes(data, int(fired.arg), self.seed, fired.hits)
+        if fired.action == "nan":
+            return float("nan")
+        return data  # pragma: no cover - _ACTIONS is closed
+
+
+def _corrupt_bytes(data, nbytes: int, seed: int, salt: int):
+    """Flip ``nbytes`` deterministically-chosen bytes of a bytes payload
+    (non-bytes data is returned untouched — corrupt only makes sense for
+    byte streams like tar members / image payloads)."""
+    if not isinstance(data, (bytes, bytearray)) or len(data) == 0:
+        return data
+    import random
+
+    rng = random.Random((seed, salt, len(data)))
+    buf = bytearray(data)
+    for _ in range(min(nbytes, len(buf))):
+        i = rng.randrange(len(buf))
+        buf[i] ^= 0xFF
+    return bytes(buf)
+
+
+# ---------------------------------------------------------------- installers
+
+_PLAN: FaultPlan | None = None
+_ENV_VAR = "GRAFT_FAULTS"
+
+
+def install_plan(spec: "str | FaultPlan | None") -> FaultPlan | None:
+    """Activate a fault plan process-wide (a string is parsed first).
+    ``None``/empty deactivates. Returns the active plan."""
+    global _PLAN
+    if spec is None or spec == "":
+        _PLAN = None
+        return None
+    plan = FaultPlan.parse(spec) if isinstance(spec, str) else spec
+    _PLAN = plan
+    return plan
+
+
+def clear_plan() -> None:
+    install_plan(None)
+
+
+def active_plan() -> FaultPlan | None:
+    return _PLAN
+
+
+def faults_active() -> bool:
+    return _PLAN is not None
+
+
+def fault_point(site: str, *, key: str | None = None, data=None):
+    """Declare a failure site. With no active plan this is a global load and
+    a branch — the zero-overhead contract production runs rely on. With a
+    plan, the first matching rule fires: ``raise``/``delay`` happen here;
+    ``corrupt``/``nan`` transform and return ``data``."""
+    plan = _PLAN
+    if plan is None:
+        return data
+    return plan.fire(site, key, data)
+
+
+# env activation: a set GRAFT_FAULTS makes every entry point (and every data
+# worker subprocess, which inherits the parent env) chaos-enabled at import
+if os.environ.get(_ENV_VAR):
+    install_plan(os.environ[_ENV_VAR])

@@ -1,0 +1,144 @@
+"""Training divergence sentinel: skip bad steps on device, roll back on runs.
+
+Two cooperating halves:
+
+- **Device guard** (:func:`guarded_apply_gradients`, compiled into the train
+  step by ``make_train_step(guard_nonfinite=True)``): an all-reduced
+  ``isfinite(loss) & isfinite(grad_norm)`` flag — the mean over the
+  globally-sharded batch IS the cross-replica value under GSPMD, so no
+  explicit collective is needed — gates the optimizer update through
+  ``lax.cond``. A non-finite step passes the state through untouched
+  (params, opt state, BatchNorm stats) except the step counter, which still
+  advances so the data stream and LR schedule stay aligned. Both branches
+  have identical structure: **no recompile**, ever.
+
+- **Host sentinel** (:class:`DivergenceSentinel`, driven by ``cli/train.py``
+  at log boundaries — per-step host sync would serialize dispatch against
+  device compute): counts consecutive bad steps (device-skipped or
+  EMA-spike), and after ``patience`` of them in a row asks for a rollback to
+  the last ``last/`` checkpoint (data cursor included). Skips, spikes and
+  rollbacks are counted in the obs registry (``train_steps_skipped_total``,
+  ``train_loss_spikes_total``, ``train_rollbacks_total``).
+
+Why both: skipping protects the state from a *transient* bad batch; rollback
+recovers from *persistent* badness (params already diverged, poisoned data
+region) that skipping can't fix because the state itself is the problem.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+
+def guarded_apply_gradients(state, grads, loss):
+    """Optimizer update gated on finiteness, inside the jitted step.
+
+    Returns ``(new_state, grad_norm, finite)``; on a non-finite ``loss`` or
+    ``grad_norm`` the update (and any BatchNorm-stats replace the caller does
+    afterwards) must be skipped — the state comes back unchanged except
+    ``step + 1``.
+    """
+    grad_norm = optax.global_norm(grads)
+    finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+
+    def _update(_):
+        return state.apply_gradients(grads=grads)
+
+    def _skip(_):
+        return state.replace(step=state.step + 1)
+
+    new_state = jax.lax.cond(finite, _update, _skip, operand=None)
+    return new_state, grad_norm, finite
+
+
+@dataclass(frozen=True)
+class SentinelConfig:
+    """Host-side divergence policy (RunConfig's ``sentinel_*`` knobs)."""
+
+    patience: int = 3           # consecutive bad steps before rollback
+    spike_factor: float = 10.0  # loss > factor x EMA counts as a bad step
+    ema_beta: float = 0.98      # loss EMA decay
+    max_rollbacks: int = 3      # give up (raise) after this many rollbacks
+
+
+class DivergenceError(RuntimeError):
+    """Raised when training diverges beyond what the sentinel can repair
+    (no checkpoint to roll back to, or ``max_rollbacks`` exhausted)."""
+
+
+class DivergenceSentinel:
+    """Streaming bad-step detector fed with per-step host metrics.
+
+    ``observe(step, metrics)`` is called once per fetched train step, in step
+    order; it returns ``True`` when the consecutive-bad streak has reached
+    ``patience`` and the caller should roll back. The EMA and streak reset
+    after a rollback (``record_rollback``) — the restored stream re-earns its
+    baseline.
+    """
+
+    def __init__(self, cfg: SentinelConfig, registry=None):
+        self.cfg = cfg
+        reg = registry if registry is not None else get_registry()
+        self._m_skipped = reg.counter(
+            "train_steps_skipped_total",
+            "optimizer updates skipped on a non-finite loss/grad",
+        )
+        self._m_spikes = reg.counter(
+            "train_loss_spikes_total",
+            f"steps whose loss exceeded spike_factor x EMA",
+        )
+        self._m_rollbacks = reg.counter(
+            "train_rollbacks_total",
+            "automatic rollbacks to the last checkpoint",
+        )
+        self.bad_streak = 0
+        self.rollbacks = 0
+        self.ema: float | None = None
+
+    def observe(self, step: int, metrics: dict) -> bool:
+        """Digest one step's host-fetched metrics; True → roll back now."""
+        skipped = float(metrics.get("skipped", 0.0)) >= 0.5
+        loss = float(metrics.get("loss", math.nan))
+        if skipped or not math.isfinite(loss):
+            self._m_skipped.inc()
+            self.bad_streak += 1
+            return self.bad_streak >= self.cfg.patience
+        if (
+            self.ema is not None
+            and self.cfg.spike_factor > 0
+            and loss > self.cfg.spike_factor * max(self.ema, 1e-12)
+        ):
+            self._m_spikes.inc()
+            self.bad_streak += 1
+            # a spike still carries signal — let the EMA drift toward it so
+            # a legitimate regime change stops counting as bad eventually
+            self._update_ema(loss)
+            return self.bad_streak >= self.cfg.patience
+        self.bad_streak = 0
+        self._update_ema(loss)
+        return False
+
+    def _update_ema(self, loss: float) -> None:
+        b = self.cfg.ema_beta
+        self.ema = loss if self.ema is None else b * self.ema + (1 - b) * loss
+
+    def record_rollback(self) -> None:
+        """Count a performed rollback and reset the streak/EMA baselines;
+        raises :class:`DivergenceError` once the budget is exhausted."""
+        self.rollbacks += 1
+        self._m_rollbacks.inc()
+        self.bad_streak = 0
+        self.ema = None
+        if self.rollbacks > self.cfg.max_rollbacks:
+            raise DivergenceError(
+                f"training diverged {self.rollbacks} times "
+                f"(sentinel_max_rollbacks={self.cfg.max_rollbacks}) — "
+                "rollback is not converging; inspect the data/LR schedule"
+            )

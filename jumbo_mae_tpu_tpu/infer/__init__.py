@@ -1,4 +1,16 @@
-from jumbo_mae_tpu_tpu.infer.batching import MicroBatcher
+from jumbo_mae_tpu_tpu.infer.batching import (
+    DeadlineExceededError,
+    MicroBatcher,
+    QueueFullError,
+    ShutdownError,
+)
 from jumbo_mae_tpu_tpu.infer.engine import InferenceEngine, bucket_for
 
-__all__ = ["InferenceEngine", "MicroBatcher", "bucket_for"]
+__all__ = [
+    "DeadlineExceededError",
+    "InferenceEngine",
+    "MicroBatcher",
+    "QueueFullError",
+    "ShutdownError",
+    "bucket_for",
+]

@@ -19,6 +19,15 @@ The batcher is engine-agnostic — ``run_fn`` is any callable mapping a
 stacked ``(k, ...)`` array to an array (or dict of arrays) with leading
 dimension ``k`` — so tests drive it with plain numpy and the serving path
 drives it with :meth:`InferenceEngine.features` et al.
+
+Overload is **bounded, not buffered**: with ``max_queue`` set, a submit
+against a full queue fails fast with :class:`QueueFullError` (shed load —
+an unbounded queue turns overload into unbounded latency for everyone);
+a per-request ``deadline_ms`` expires queued requests with
+:class:`DeadlineExceededError` at batch-admission time instead of letting a
+stale request occupy a batch slot; and :meth:`close` resolves every pending
+future with :class:`ShutdownError` — a ``submit()`` caller can never block
+forever on a batcher that is shutting down.
 """
 
 from __future__ import annotations
@@ -31,18 +40,36 @@ from typing import Any, Callable
 
 import numpy as np
 
+from jumbo_mae_tpu_tpu.faults.inject import fault_point
 from jumbo_mae_tpu_tpu.obs.metrics import RATIO_BUCKETS, get_registry
 
 _STOP = object()
+
+
+class QueueFullError(RuntimeError):
+    """Raised by ``submit()`` when the request queue is at ``max_queue`` —
+    the caller should shed/retry elsewhere, not wait."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """Set on a request future whose ``deadline_ms`` passed before the
+    collector could admit it to a batch."""
+
+
+class ShutdownError(RuntimeError):
+    """Set on pending request futures when the batcher closes."""
 
 
 class MicroBatcher:
     """Thread-safe request coalescer in front of a batched ``run_fn``.
 
     ``max_delay_ms`` bounds the extra latency any request can pay waiting
-    for co-travelers; ``max_batch`` bounds the batch handed to ``run_fn``.
-    ``batch_sizes`` records every flushed batch's size (bench/test
-    observability). Use as a context manager or call :meth:`close`.
+    for co-travelers; ``max_batch`` bounds the batch handed to ``run_fn``;
+    ``max_queue`` bounds how many submitted-but-unflushed requests may
+    exist before ``submit`` sheds with :class:`QueueFullError` (``None`` =
+    unbounded, the pre-backpressure behavior). ``batch_sizes`` records
+    every flushed batch's size (bench/test observability). Use as a
+    context manager or call :meth:`close`.
     """
 
     def __init__(
@@ -51,13 +78,17 @@ class MicroBatcher:
         *,
         max_batch: int = 32,
         max_delay_ms: float = 5.0,
+        max_queue: int | None = None,
         registry=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.run_fn = run_fn
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1000.0
+        self.max_queue = max_queue
         self.batch_sizes: list[int] = []
         # serving telemetry (obs/metrics.py): submit→result latency is THE
         # operator number — it includes coalescing wait, queueing behind
@@ -84,8 +115,23 @@ class MicroBatcher:
         self._m_failed = reg.counter(
             "infer_requests_failed_total", "requests failed by a run_fn error"
         )
+        self._m_shed = reg.counter(
+            "infer_requests_shed_total",
+            "submits rejected with QueueFullError (queue at max_queue)",
+        )
+        self._m_expired = reg.counter(
+            "infer_deadline_exceeded_total",
+            "requests expired past their deadline before batch admission",
+        )
+        self._m_aborted = reg.counter(
+            "infer_requests_aborted_total",
+            "pending requests failed by close()",
+        )
         self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._depth = 0               # submitted, not yet popped by the loop
+        self._depth_lock = threading.Lock()
         self._closed = False
+        self._drain = True
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="microbatcher"
         )
@@ -93,27 +139,69 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- client
 
-    def submit(self, image: np.ndarray) -> Future:
+    def submit(
+        self, image: np.ndarray, *, deadline_ms: float | None = None
+    ) -> Future:
         """Enqueue one request (a single image, no batch dim); returns a
-        future resolving to that request's row of the batched result."""
+        future resolving to that request's row of the batched result.
+
+        Raises :class:`QueueFullError` immediately when ``max_queue``
+        requests are already pending (shed, don't buffer). With
+        ``deadline_ms``, a request still queued that long after submit is
+        failed with :class:`DeadlineExceededError` instead of occupying a
+        slot in a batch.
+        """
+        fault_point("serve.submit")
         if self._closed:
             raise RuntimeError("MicroBatcher is closed")
+        with self._depth_lock:
+            if self.max_queue is not None and self._depth >= self.max_queue:
+                self._m_shed.inc()
+                raise QueueFullError(
+                    f"request queue full ({self._depth}/{self.max_queue})"
+                )
+            self._depth += 1
         fut: Future = Future()
-        # submit stays metric-free (counted batch-at-a-time in _flush): at
-        # CPU-smoke request rates even one lock per submit is measurable
-        self._q.put((np.asarray(image), fut, time.perf_counter()))
+        deadline = (
+            None
+            if deadline_ms is None
+            else time.monotonic() + float(deadline_ms) / 1000.0
+        )
+        # submit stays latency-metric-free (counted batch-at-a-time in
+        # _flush): at CPU-smoke request rates even one observe per submit
+        # is measurable; the depth lock above is one uncontended acquire
+        self._q.put((np.asarray(image), fut, time.perf_counter(), deadline))
         return fut
 
-    def __call__(self, image: np.ndarray):
+    def __call__(self, image: np.ndarray, *, deadline_ms: float | None = None):
         """Blocking convenience: submit and wait."""
-        return self.submit(image).result()
+        return self.submit(image, deadline_ms=deadline_ms).result()
 
-    def close(self):
-        """Flush pending requests and stop the collector thread."""
+    def close(self, drain: bool = True):
+        """Stop the collector and resolve EVERY pending request — no caller
+        can be left blocked on a future forever.
+
+        ``drain=True`` (default): shed — pending (unflushed) requests fail
+        fast with :class:`ShutdownError` without running ``run_fn`` again.
+        ``drain=False``: graceful — requests queued before close still
+        flush through ``run_fn``; only late racers are failed.
+        """
         if not self._closed:
+            self._drain = drain
             self._closed = True
             self._q.put(_STOP)
             self._thread.join()
+            # sweep whatever the loop never popped (items enqueued behind
+            # the stop sentinel by racing submits)
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _STOP:
+                    continue
+                self._dec()
+                self._abort(item)
 
     def __enter__(self):
         return self
@@ -123,12 +211,38 @@ class MicroBatcher:
 
     # ---------------------------------------------------------- collector
 
+    def _dec(self):
+        with self._depth_lock:
+            self._depth -= 1
+
+    def _abort(self, item):
+        self._m_aborted.inc()
+        item[1].set_exception(ShutdownError("MicroBatcher closed"))
+
+    def _admit(self, item, batch) -> None:
+        """One popped request: shutdown-shed / deadline-expire / admit."""
+        self._dec()
+        if self._closed and self._drain:
+            self._abort(item)
+            return
+        dl = item[3]
+        if dl is not None and time.monotonic() > dl:
+            self._m_expired.inc()
+            item[1].set_exception(
+                DeadlineExceededError("request deadline passed while queued")
+            )
+            return
+        batch.append(item)
+
     def _loop(self):
         while True:
             item = self._q.get()
             if item is _STOP:
                 return
-            batch = [item]
+            batch: list = []
+            self._admit(item, batch)
+            if not batch:
+                continue
             self._m_depth.set(self._q.qsize() + 1)
             deadline = time.monotonic() + self.max_delay
             stop = False
@@ -143,7 +257,7 @@ class MicroBatcher:
                 if nxt is _STOP:
                     stop = True
                     break
-                batch.append(nxt)
+                self._admit(nxt, batch)
             self._flush(batch)
             if stop:
                 return
@@ -154,19 +268,19 @@ class MicroBatcher:
         self._m_requests.inc(len(batch))
         self._m_occupancy.observe(len(batch) / self.max_batch)
         try:
-            out = self.run_fn(np.stack([img for img, _, _ in batch]))
+            out = self.run_fn(np.stack([img for img, _, _, _ in batch]))
         except BaseException as e:  # noqa: BLE001 — route to the waiters
             self._m_failed.inc(len(batch))
-            for _, fut, _ in batch:
+            for _, fut, _, _ in batch:
                 fut.set_exception(e)
             return
         done = time.perf_counter()
         # one lock hand-off for the whole batch's latencies, before the
         # waiters wake (their submit→result time must not include it)
-        self._m_latency.observe_many([done - t for _, _, t in batch])
+        self._m_latency.observe_many([done - t for _, _, t, _ in batch])
         if isinstance(out, dict):
-            for i, (_, fut, _) in enumerate(batch):
+            for i, (_, fut, _, _) in enumerate(batch):
                 fut.set_result({k: v[i] for k, v in out.items()})
         else:
-            for (_, fut, _), row in zip(batch, out):
+            for (_, fut, _, _), row in zip(batch, out):
                 fut.set_result(row)

@@ -31,6 +31,11 @@ class HealthState:
     loop calls. The report is unhealthy if not ready, or any watched
     heartbeat is older than its budget (a watched name never beaten is
     age-infinite, i.e. unhealthy — a loop that never started is not live).
+
+    Named **info probes** (``probe(name, fn)``) attach extra read-only
+    context to the report body — e.g. the data layer's quarantined-shard
+    list — without affecting the health verdict; a probe that raises
+    reports its error string instead of breaking the endpoint.
     """
 
     def __init__(self, *, ready: bool = False):
@@ -39,6 +44,7 @@ class HealthState:
         self._detail = ""
         self._max_age: dict[str, float] = {}
         self._beats: dict[str, float] = {}
+        self._probes: dict[str, object] = {}
 
     def set_ready(self, ready: bool = True, detail: str = "") -> None:
         with self._lock:
@@ -58,11 +64,18 @@ class HealthState:
         # monotonic: wall-clock jumps must not flip health
         self._beats[name] = time.monotonic()
 
+    def probe(self, name: str, fn) -> None:
+        """Attach a zero-arg callable whose JSON-able return value is
+        included in the report body under ``info[name]``."""
+        with self._lock:
+            self._probes[name] = fn
+
     def report(self) -> tuple[bool, dict]:
         now = time.monotonic()
         with self._lock:
             ready, detail = self._ready, self._detail
             watches = dict(self._max_age)
+            probes = dict(self._probes)
         checks = {}
         ok = ready
         for name, budget in sorted(watches.items()):
@@ -76,6 +89,14 @@ class HealthState:
                 "ok": alive,
             }
         body = {"ok": ok, "ready": ready, "checks": checks}
+        if probes:
+            info = {}
+            for name, fn in sorted(probes.items()):
+                try:
+                    info[name] = fn()
+                except Exception as e:  # noqa: BLE001 — never break /healthz
+                    info[name] = f"probe error: {type(e).__name__}: {e}"
+            body["info"] = info
         if detail:
             body["detail"] = detail
         return ok, body

@@ -212,6 +212,11 @@ class Checkpointer:
     ) -> bool:
         """Save ``last``; promote to ``best`` on metric improvement.
         Returns True if this step became the new best."""
+        # chaos hook: a wedged/failing checkpoint store is a classic
+        # pod-scale failure — injectable without a real flaky filesystem
+        from jumbo_mae_tpu_tpu.faults.inject import fault_point
+
+        fault_point("ckpt.save", key=str(step))
         extra = dict(extra or {})
         state, was_typed = split_rng_for_save(state)
         extra["_rng_typed"] = was_typed

@@ -31,9 +31,11 @@ from typing import Any, Callable, Literal
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh
 
+from jumbo_mae_tpu_tpu.faults.sentinel import guarded_apply_gradients
 from jumbo_mae_tpu_tpu.parallel.sharding import (
     batch_sharding,
     infer_state_sharding,
@@ -131,12 +133,27 @@ def make_train_step(
     pipe_microbatches: int = 0,
     encoder_cfg: Any = None,
     decoder_cfg: Any = None,
+    guard_nonfinite: bool = False,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step.
 
     ``grad_accum == 1``: batch leaves are (batch, ...).
     ``grad_accum > 1``: batch leaves are (accum, micro, ...) and a
     ``lax.scan`` accumulates gradients before the single optimizer update.
+
+    ``guard_nonfinite=True`` compiles the divergence guard into the step
+    (``faults/sentinel.py``): non-finite loss or grad-norm steps skip the
+    optimizer update via ``lax.cond`` — state passes through untouched
+    except ``step + 1`` — and the metrics gain ``grad_norm`` and
+    ``skipped``. Same program either way batch-to-batch: no recompile.
+
+    The returned callable accepts an optional third argument ``inject`` —
+    a ``(2,)`` float32 host array ``[loss_mult, grad_mult]`` (defaults to
+    ones) multiplied into the differentiated loss and the gradients. It is
+    a *traced* input, so the fault-injection harness can turn a chosen
+    step's loss/grads NaN (``train.loss`` / ``train.grad`` sites) without
+    triggering a compile; a multiply by exactly 1.0 is bit-exact in every
+    float dtype, so un-injected runs are numerically identical.
 
     ``pipe_microbatches > 0`` (requires ``encoder_cfg`` and a mesh with a
     ``pipe`` axis): the encoder's block chain runs through the GPipe
@@ -180,7 +197,7 @@ def make_train_step(
                 decoder_cfg.droppath or 0
             ) > 0
 
-    def loss_fn(params, batch_stats, micro_idx, batch, state):
+    def loss_fn(params, batch_stats, micro_idx, batch, state, loss_mult):
         rngs = state.step_rngs(micro=micro_idx)
         variables = {"params": params}
         extra = {}
@@ -231,20 +248,25 @@ def make_train_step(
             for k, v in out.items()
             if not k.endswith("_per_sample")
         }
-        return metrics["loss"], (metrics, new_stats)
+        return metrics["loss"] * loss_mult, (metrics, new_stats)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     @partial(
         jax.jit,
         donate_argnums=(0,),
-        in_shardings=(state_sharding, batch_sharding(mesh, accum=grad_accum > 1)),
+        in_shardings=(
+            state_sharding,
+            batch_sharding(mesh, accum=grad_accum > 1),
+            None,
+        ),
         out_shardings=(state_sharding, None),
     )
-    def train_step(state: TrainState, batch: dict):
+    def _train_step(state: TrainState, batch: dict, inject):
+        loss_mult, grad_mult = inject[0], inject[1]
         if grad_accum == 1:
             (_, (metrics, new_stats)), grads = grad_fn(
-                state.params, state.batch_stats, 0, batch, state
+                state.params, state.batch_stats, 0, batch, state, loss_mult
             )
         else:
             metrics_shape = jax.eval_shape(
@@ -254,6 +276,7 @@ def make_train_step(
                     0,
                     jax.tree_util.tree_map(lambda x: x[0], batch),
                     state,
+                    loss_mult,
                 )[1][0]
             )
             # Accumulate in float32 even when params (and so grads) are
@@ -272,7 +295,7 @@ def make_train_step(
                 grads_acc, metrics_acc, stats = carry
                 idx, micro_batch = xs
                 (_, (metrics, new_stats)), grads = grad_fn(
-                    state.params, stats, idx, micro_batch, state
+                    state.params, stats, idx, micro_batch, state, loss_mult
                 )
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), grads
@@ -289,13 +312,46 @@ def make_train_step(
             grads = _tree_scale(grads, 1.0 / grad_accum)
             metrics = _tree_scale(metrics, 1.0 / grad_accum)
 
-        state = state.apply_gradients(grads=grads)
-        if new_stats is not None:
-            state = state.replace(batch_stats=new_stats)
+        grads = jax.tree_util.tree_map(
+            lambda g: g * grad_mult.astype(g.dtype), grads
+        )
+        if guard_nonfinite:
+            # the guard must see the INJECTED loss (metrics keep the raw
+            # one): raw_loss x loss_mult is exactly the differentiated value
+            loss_val = metrics["loss"] * loss_mult
+            state, grad_norm, finite = guarded_apply_gradients(
+                state, grads, loss_val
+            )
+            if new_stats is not None:
+                # BatchNorm stats from a non-finite forward are tainted too
+                state = state.replace(
+                    batch_stats=jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(finite, new, old),
+                        new_stats,
+                        state.batch_stats,
+                    )
+                )
+            metrics = metrics | {
+                "grad_norm": grad_norm,
+                "skipped": 1.0 - finite.astype(jnp.float32),
+            }
+        else:
+            state = state.apply_gradients(grads=grads)
+            if new_stats is not None:
+                state = state.replace(batch_stats=new_stats)
         hyper = getattr(state.opt_state, "hyperparams", None)
         if hyper is not None:
             metrics = metrics | {"learning_rate": hyper["learning_rate"]}
         return state, metrics
+
+    no_inject = np.ones(2, np.float32)
+
+    def train_step(state: TrainState, batch: dict, inject=None):
+        return _train_step(
+            state,
+            batch,
+            no_inject if inject is None else np.asarray(inject, np.float32),
+        )
 
     return train_step
 

@@ -311,9 +311,9 @@ def test_valid_cache_zero_shard_rereads(shard_dir, tmp_path, monkeypatch):
     reads = {"n": 0}
     real = loader_mod.iter_shards_samples
 
-    def counting(shards):
+    def counting(shards, **kw):
         reads["n"] += 1
-        return real(shards)
+        return real(shards, **kw)
 
     monkeypatch.setattr(loader_mod, "iter_shards_samples", counting)
 
