@@ -17,6 +17,7 @@ Run:
 from __future__ import annotations
 
 import argparse
+import math
 import time
 from pathlib import Path
 
@@ -62,12 +63,19 @@ from jumbo_mae_tpu_tpu.train import (
     make_train_step,
 )
 from jumbo_mae_tpu_tpu.obs import (
+    FlightRecorder,
     HealthState,
+    RunJournal,
     TelemetryServer,
+    env_fingerprint,
     export_chrome_trace,
+    first_nonfinite_group,
     get_registry,
+    group_layout,
+    publish_group_stats,
     span_timer,
     start_chrome_trace,
+    stats_dict,
     trace,
 )
 from jumbo_mae_tpu_tpu.utils import (
@@ -582,6 +590,10 @@ def train(cfg: TrainConfig) -> dict:
     # mesh.pipe_decoder additionally depth-shards the MAE decoder stack
     # (pretrain only; mesh.pipe must divide dec_layers)
     dec_cfg = model.decoder_cfg if cfg.mesh.pipe_decoder else None
+    # per-layer-group diagnostics (obs/modelstats): a STATIC flag — with
+    # diag_every=0 the compiled step program is byte-identical to pre-diag
+    diag_on = run.diag_every > 0 and not run.eval_only
+    diag_names = group_layout(state.params) if diag_on else ()
     train_step = (
         None
         if run.eval_only  # dead work in an eval-and-exit run
@@ -594,6 +606,7 @@ def train(cfg: TrainConfig) -> dict:
             encoder_cfg=enc_cfg if pipe_microbatches else None,
             decoder_cfg=dec_cfg,
             guard_nonfinite=run.sentinel,
+            diag=diag_on,
         )
     )
     eval_step = make_eval_step(mesh, state_sharding, mode=mode_key)
@@ -678,6 +691,53 @@ def train(cfg: TrainConfig) -> dict:
             evaluate(eval_step, state, valid_factory(), pad_batch),
         )
 
+    # run-history diagnostics (host 0, like the logger): the crash-safe
+    # journal under <run_dir>/journal/ and the black-box flight recorder
+    # dumping into <run_dir>/ on non-finite steps, rollbacks, SIGTERM, or
+    # an escaping exception. Installed AFTER the preemption guard so its
+    # SIGTERM handler dumps first, then chains into graceful checkpointing.
+    run_dir = Path(run.output_dir) / run.name
+    journal = (
+        RunJournal(run_dir / "journal") if run.journal and is_main else None
+    )
+    flightrec = (
+        FlightRecorder(run_dir, capacity=run.flightrec_steps)
+        if run.flightrec_steps > 0 and is_main
+        else None
+    )
+    if flightrec is not None:
+        flightrec.install()
+
+    def _emit(etype: str, **fields) -> None:
+        """One diagnostic event → journal (durable) + flight ring (memory)."""
+        rec = {"ts": round(time.time(), 3), "type": etype, **fields}
+        if journal is not None:
+            try:
+                rec = journal.event(etype, **fields)
+            except OSError as e:  # a full disk must not kill the run
+                print(f"[obs] WARNING: journal write failed: {e}")
+        if flightrec is not None:
+            flightrec.record_event(rec)
+
+    def _black_box(reason: str, **extra) -> None:
+        if flightrec is None:
+            return
+        path = flightrec.dump(reason, extra=extra or None)
+        _emit("flight_record", reason=reason, path=str(path))
+        print(f"[obs] flight record ({reason}) -> {path}")
+
+    if journal is not None:
+        health.probe("journal", lambda: str(journal.path))
+    _emit(
+        "run_start",
+        config=config_to_dict(cfg),
+        env=env_fingerprint(),
+        start_step=start_step,
+        resumed=bool(resuming),
+        diag_every=run.diag_every,
+        diag_groups=list(diag_names),
+    )
+
     train_iter, source, cursor_log = make_train_iterator(
         cfg, mesh, per_process, start_step, data_cursor,
         num_labels=enc_cfg.labels or 1000,
@@ -701,6 +761,14 @@ def train(cfg: TrainConfig) -> dict:
         if run.sentinel
         else None
     )
+    if sentinel is not None:
+        # per-step sentinel verdicts into the journal with exact step
+        # indices; the loop emits the richer rollback event itself
+        sentinel.on_event = lambda kind, payload: (
+            _emit(f"sentinel_{kind}", **payload)
+            if kind != "rollback"
+            else None
+        )
 
     # step-loop telemetry: spans aggregate into span_seconds{name=...}; the
     # gauges publish the log-window derived numbers the logger prints.
@@ -713,6 +781,9 @@ def train(cfg: TrainConfig) -> dict:
         "train_data_wait_fraction", "share of wall time waiting on data"
     )
     g_step = reg.gauge("train_step", "current absolute step")
+    g_grad_norm = reg.gauge(
+        "train_grad_norm", "global gradient norm of the last fetched step"
+    )
     c_steps = reg.counter("train_steps_total", "optimizer steps this process")
     sp_wait = span_timer("data_wait")
     sp_step = span_timer("train_step")
@@ -725,140 +796,265 @@ def train(cfg: TrainConfig) -> dict:
         start_chrome_trace()
     window_t0, window_wait = time.perf_counter(), 0.0
 
-    with trace(run.profile_dir or None):
-        pending: list = []  # [(step, device-metrics)] fetched at log time
-        step = start_step
-        while step < run.training_steps:
-            step += 1
-            with sp_wait:
-                batch = next(train_iter)
-            window_wait += sp_wait.last_s
-            health.beat("data_batch")
-            # fault sites train.loss / train.grad: traced multipliers into
-            # the step (NaN at chosen invocations, no recompile); the
-            # branch costs nothing when no plan is active
-            inject = None
-            if faults_active():
-                lm = fault_point("train.loss", key=str(step), data=1.0)
-                gm = fault_point("train.grad", key=str(step), data=1.0)
-                if (lm, gm) != (1.0, 1.0):
-                    inject = np.asarray([lm, gm], np.float32)
-            with sp_step:
-                if inject is None:
-                    state, metrics = train_step(state, batch)
-                else:
-                    state, metrics = train_step(state, batch, inject)
-            c_steps.inc()
-            g_step.set(step)
-            health.beat("train_step")
-            pending.append((step, metrics))
-            timer.tick()
-            # only cursor_log[step] (and prefetched future steps) are ever
-            # read — prune dead entries every iteration, not just at save
-            # time, or sparse checkpointing grows host memory without bound
-            for k in [k for k in cursor_log if k < step]:
-                del cursor_log[k]
-
-            if step % run.log_interval == 0 or step == run.training_steps:
-                # sync ONLY at log boundaries — per-step device_get/block
-                # would serialize host dispatch against device compute
-                want_rollback = False
-                for (s, m) in zip(
-                    (s for s, _ in pending),
-                    jax.device_get([m for _, m in pending]),
-                ):
-                    skipped = float(m.get("skipped", 0.0)) >= 0.5
-                    if sentinel is not None and sentinel.observe(s, m):
-                        want_rollback = True
-                    if not skipped:
-                        # a skipped step's loss is the garbage the guard
-                        # refused to apply — keep it out of the log means
-                        meter.update(m)
-                pending.clear()
-                summary = meter.summary("train/")
-                sps = timer.steps_per_sec
-                if sps:
-                    imgs = sps * run.train_batch_size
-                    rep = mfu_report(flops_per_image, imgs / n_chips)
-                    summary |= {
-                        "perf/images_per_sec": imgs,
-                        "perf/images_per_sec_per_chip": imgs / n_chips,
-                        "perf/mfu": rep.mfu,
-                        "perf/tflops_per_chip": rep.achieved_tflops,
-                    }
-                    g_mfu.set(rep.mfu)
-                    g_ips.set(imgs)
-                now = time.perf_counter()
-                g_wait_frac.set(window_wait / max(now - window_t0, 1e-9))
-                window_t0, window_wait = now, 0.0
-                logger.log(summary, step=step)
-                last_metrics = summary
-
-                if want_rollback:
-                    # persistent divergence: restore the last checkpoint
-                    # (params + optimizer + RNG + data cursor) and continue
-                    # from there. Skipping alone can't fix a state that is
-                    # already bad — rewinding to a known-good one can.
-                    if ckpt.latest_step("last") is None:
-                        raise DivergenceError(
-                            f"training diverged at step {step} with no "
-                            "checkpoint to roll back to — lower the LR or "
-                            "set run.eval_interval below the failure point"
-                        )
-                    sentinel.record_rollback()  # raises once budget is spent
-                    ckpt.wait()  # a save may still be in flight
-                    state, extra = ckpt.restore(state, sharding=state_sharding)
-                    step = int(state.step)
-                    print(
-                        f"[train] sentinel rollback #{sentinel.rollbacks} → "
-                        f"resuming from step {step}"
-                    )
-                    if source is not None:
-                        source.close()
-                    train_iter, source, cursor_log = make_train_iterator(
-                        cfg, mesh, per_process, step,
-                        extra.get("data_cursor"),
-                        num_labels=enc_cfg.labels or 1000,
-                    )
-                    continue
-
-            saved_this_step = False
-            if step % run.eval_interval == 0 or step == run.training_steps:
-                snap = _gather_data_cursor(cursor_log.get(step))
-                extra = {"data_cursor": snap} if snap is not None else None
-                for k in [k for k in cursor_log if k <= step]:
+    exit_reason = "completed"
+    pending: list = []  # [(step, device-metrics)] fetched at log time
+    diag_pending: list = []  # [(step, device (G,3) stats)] fetched at log time
+    prev_window_bad = False  # edge-trigger for the non-finite black box
+    seen_quarantine: set = set()
+    step = start_step
+    try:
+        with trace(run.profile_dir or None):
+            while step < run.training_steps:
+                step += 1
+                with sp_wait:
+                    batch = next(train_iter)
+                window_wait += sp_wait.last_s
+                health.beat("data_batch")
+                # fault sites train.loss / train.grad: traced multipliers into
+                # the step (NaN at chosen invocations, no recompile); the
+                # branch costs nothing when no plan is active
+                inject = None
+                if faults_active():
+                    lm = fault_point("train.loss", key=str(step), data=1.0)
+                    gm = fault_point("train.grad", key=str(step), data=1.0)
+                    if (lm, gm) != (1.0, 1.0):
+                        inject = np.asarray([lm, gm], np.float32)
+                with sp_step:
+                    if inject is None:
+                        state, metrics = train_step(state, batch)
+                    else:
+                        state, metrics = train_step(state, batch, inject)
+                c_steps.inc()
+                g_step.set(step)
+                health.beat("train_step")
+                if diag_on:
+                    # keep the (G,3) stats array OUT of the scalar pending list
+                    # (the meter/sentinel consume scalars); fetch it only at the
+                    # diag cadence — off-cadence arrays are dropped on device
+                    metrics = dict(metrics)
+                    diag_dev = metrics.pop("diag")
+                    if step % run.diag_every == 0 or step == run.training_steps:
+                        diag_pending.append((step, diag_dev))
+                pending.append((step, metrics))
+                timer.tick()
+                # only cursor_log[step] (and prefetched future steps) are ever
+                # read — prune dead entries every iteration, not just at save
+                # time, or sparse checkpointing grows host memory without bound
+                for k in [k for k in cursor_log if k < step]:
                     del cursor_log[k]
-                if valid_factory is not None:
-                    val = evaluate(eval_step, state, valid_factory(), pad_batch)
-                    logger.log(val, step=step)
-                    last_metrics |= val
-                    with sp_ckpt:
-                        ckpt.save(step, state, metrics=val, extra=extra)
-                else:
-                    with sp_ckpt:
-                        ckpt.save(step, state, extra=extra)
-                saved_this_step = True
 
-            # Graceful preemption: single-host checks the flag every step;
-            # multi-host only at log/eval boundaries (reaching agreement
-            # needs a host allgather, which would serialize dispatch if done
-            # per step), which is well inside any preemption grace window.
-            boundary = (
-                process_count == 1
-                or saved_this_step
-                or step % run.log_interval == 0
-            )
-            if boundary and _agree_on_preemption(preempt, process_count):
-                if not saved_this_step:
-                    snap = _gather_data_cursor(cursor_log.get(step))
-                    with sp_ckpt:
-                        ckpt.save(
-                            step,
-                            state,
-                            extra={"data_cursor": snap} if snap is not None else None,
+                if step % run.log_interval == 0 or step == run.training_steps:
+                    # sync ONLY at log boundaries — per-step device_get/block
+                    # would serialize host dispatch against device compute
+                    want_rollback = False
+                    window_bad: list[int] = []
+                    for (s, m) in zip(
+                        (s for s, _ in pending),
+                        jax.device_get([m for _, m in pending]),
+                    ):
+                        skipped = float(m.get("skipped", 0.0)) >= 0.5
+                        loss_v = float(m.get("loss", math.nan))
+                        if skipped or not math.isfinite(loss_v):
+                            window_bad.append(s)
+                        gn = m.get("grad_norm")
+                        if gn is not None:
+                            g_grad_norm.set(float(gn))
+                        if flightrec is not None:
+                            entry = {"loss": loss_v}
+                            if gn is not None:
+                                entry["grad_norm"] = float(gn)
+                            if "finite_frac" in m:
+                                entry["finite_frac"] = float(m["finite_frac"])
+                            if skipped:
+                                entry["skipped"] = True
+                            flightrec.record_step(s, entry)
+                        if sentinel is not None and sentinel.observe(s, m):
+                            want_rollback = True
+                        if not skipped:
+                            # a skipped step's loss is the garbage the guard
+                            # refused to apply — keep it out of the log means
+                            meter.update(m)
+                    pending.clear()
+                    # per-layer-group diagnostics: one small stacked array per
+                    # diag step, published as model_*{group=...} gauges
+                    latest_diag = None
+                    if diag_pending:
+                        for (ds, _), arr in zip(
+                            diag_pending,
+                            jax.device_get([a for _, a in diag_pending]),
+                        ):
+                            publish_group_stats(diag_names, arr)
+                            latest_diag = (ds, stats_dict(diag_names, arr), arr)
+                            if flightrec is not None:
+                                flightrec.record_step(ds, {"diag": latest_diag[1]})
+                        diag_pending.clear()
+                    summary = meter.summary("train/")
+                    sps = timer.steps_per_sec
+                    if sps:
+                        imgs = sps * run.train_batch_size
+                        rep = mfu_report(flops_per_image, imgs / n_chips)
+                        summary |= {
+                            "perf/images_per_sec": imgs,
+                            "perf/images_per_sec_per_chip": imgs / n_chips,
+                            "perf/mfu": rep.mfu,
+                            "perf/tflops_per_chip": rep.achieved_tflops,
+                        }
+                        g_mfu.set(rep.mfu)
+                        g_ips.set(imgs)
+                    now = time.perf_counter()
+                    wait_frac = window_wait / max(now - window_t0, 1e-9)
+                    g_wait_frac.set(wait_frac)
+                    window_t0, window_wait = now, 0.0
+                    logger.log(summary, step=step)
+                    last_metrics = summary
+
+                    # durable step snapshot + newly quarantined shards
+                    if journal is not None or flightrec is not None:
+                        snap_ev = {
+                            "step": step,
+                            "metrics": summary,
+                            "data_wait_fraction": round(wait_frac, 4),
+                        }
+                        if window_bad:
+                            snap_ev["bad_steps"] = window_bad
+                        if latest_diag is not None:
+                            snap_ev["diag_step"] = latest_diag[0]
+                            snap_ev["diag"] = latest_diag[1]
+                        _emit("step", **snap_ev)
+                        new_q = set(QUARANTINE.snapshot()) - seen_quarantine
+                        if new_q:
+                            seen_quarantine |= new_q
+                            _emit("quarantine", shards=sorted(new_q))
+                    # black box on the first bad window (edge-triggered: a long
+                    # NaN streak is one incident, not a dump per log boundary)
+                    if window_bad:
+                        if flightrec is not None:
+                            flightrec.mark_abnormal()
+                        if not prev_window_bad:
+                            grp = (
+                                first_nonfinite_group(diag_names, latest_diag[2])
+                                if latest_diag is not None
+                                else None
+                            )
+                            _black_box(
+                                "nonfinite_step",
+                                bad_steps=window_bad,
+                                first_nonfinite_group=grp,
+                            )
+                    prev_window_bad = bool(window_bad)
+
+                    if want_rollback:
+                        # persistent divergence: restore the last checkpoint
+                        # (params + optimizer + RNG + data cursor) and continue
+                        # from there. Skipping alone can't fix a state that is
+                        # already bad — rewinding to a known-good one can.
+                        if ckpt.latest_step("last") is None:
+                            raise DivergenceError(
+                                f"training diverged at step {step} with no "
+                                "checkpoint to roll back to — lower the LR or "
+                                "set run.eval_interval below the failure point"
+                            )
+                        sentinel.record_rollback()  # raises once budget is spent
+                        ckpt.wait()  # a save may still be in flight
+                        state, extra = ckpt.restore(state, sharding=state_sharding)
+                        rolled_from, step = step, int(state.step)
+                        print(
+                            f"[train] sentinel rollback #{sentinel.rollbacks} → "
+                            f"resuming from step {step}"
                         )
-                print(f"[train] preemption checkpoint at step {step}; exiting")
-                break
+                        _emit(
+                            "rollback",
+                            from_step=rolled_from,
+                            to_step=step,
+                            rollbacks=sentinel.rollbacks,
+                            bad_steps=window_bad,
+                        )
+                        # every rollback leaves a black box: the per-step ring
+                        # around the divergence, not just the fact of it
+                        _black_box(
+                            "sentinel_rollback",
+                            from_step=rolled_from,
+                            to_step=step,
+                            rollbacks=sentinel.rollbacks,
+                        )
+                        prev_window_bad = False  # restored stream starts clean
+                        if source is not None:
+                            source.close()
+                        train_iter, source, cursor_log = make_train_iterator(
+                            cfg, mesh, per_process, step,
+                            extra.get("data_cursor"),
+                            num_labels=enc_cfg.labels or 1000,
+                        )
+                        continue
+
+                saved_this_step = False
+                if step % run.eval_interval == 0 or step == run.training_steps:
+                    snap = _gather_data_cursor(cursor_log.get(step))
+                    extra = {"data_cursor": snap} if snap is not None else None
+                    for k in [k for k in cursor_log if k <= step]:
+                        del cursor_log[k]
+                    if valid_factory is not None:
+                        val = evaluate(eval_step, state, valid_factory(), pad_batch)
+                        logger.log(val, step=step)
+                        last_metrics |= val
+                        with sp_ckpt:
+                            ckpt.save(step, state, metrics=val, extra=extra)
+                    else:
+                        val = None
+                        with sp_ckpt:
+                            ckpt.save(step, state, extra=extra)
+                    saved_this_step = True
+                    _emit(
+                        "checkpoint_save",
+                        step=step,
+                        eval_metrics=val,
+                        save_seconds=round(sp_ckpt.last_s, 3),
+                    )
+
+                # Graceful preemption: single-host checks the flag every step;
+                # multi-host only at log/eval boundaries (reaching agreement
+                # needs a host allgather, which would serialize dispatch if done
+                # per step), which is well inside any preemption grace window.
+                boundary = (
+                    process_count == 1
+                    or saved_this_step
+                    or step % run.log_interval == 0
+                )
+                if boundary and _agree_on_preemption(preempt, process_count):
+                    if not saved_this_step:
+                        snap = _gather_data_cursor(cursor_log.get(step))
+                        with sp_ckpt:
+                            ckpt.save(
+                                step,
+                                state,
+                                extra={"data_cursor": snap} if snap is not None else None,
+                            )
+                        _emit("checkpoint_save", step=step, preemption=True)
+                    print(f"[train] preemption checkpoint at step {step}; exiting")
+                    exit_reason = "preempted"
+                    break
+    except BaseException as e:
+        # the black box is most valuable exactly here: the run is dying and
+        # the in-memory ring is about to vanish
+        exit_reason = (
+            "diverged"
+            if isinstance(e, DivergenceError)
+            else f"exception:{type(e).__name__}"
+        )
+        if flightrec is not None:
+            try:
+                flightrec.dump(
+                    "exception", extra={"error": f"{type(e).__name__}: {e}"}
+                )
+            except Exception:  # noqa: BLE001 - never mask the real failure
+                pass
+        raise
+    finally:
+        _emit("shutdown", reason=exit_reason, step=step)
+        if flightrec is not None:
+            flightrec.uninstall()
+        if journal is not None:
+            journal.close()
 
     ckpt.wait()
     ckpt.close()

@@ -97,6 +97,17 @@ class RunConfig:
     sentinel_ema_beta: float = 0.98
     sentinel_max_rollbacks: int = 3
     faults: str = ""
+    # diagnostics (obs/modelstats, obs/journal, obs/flightrec):
+    # diag_every > 0 compiles per-layer-group grad/param/update-ratio stats
+    # + the loss batch's finite fraction into the train step (one extra
+    # (groups, 3) array out; the base program is untouched at 0) and
+    # fetches/publishes them every diag_every steps. `journal` writes the
+    # append-only crash-safe run journal under <output_dir>/<name>/journal/.
+    # flightrec_steps sizes the crash flight recorder's per-step ring
+    # buffer (0 disables black-box dumps entirely).
+    diag_every: int = 0
+    journal: bool = True
+    flightrec_steps: int = 256
     # telemetry (jumbo_mae_tpu_tpu/obs): metrics are always *recorded*; the
     # exporter serving them over HTTP (/metrics Prometheus text, /healthz)
     # is opt-in. Port 0 binds any free port (the chosen one is printed).

@@ -81,10 +81,18 @@ class DivergenceSentinel:
     ``patience`` and the caller should roll back. The EMA and streak reset
     after a rollback (``record_rollback``) — the restored stream re-earns its
     baseline.
+
+    ``on_event`` (settable anytime) is the diagnostics tap: a callable
+    ``(kind, payload_dict)`` invoked on every ``bad_step`` / ``loss_spike``
+    / ``rollback`` verdict with the exact step index — the run journal and
+    flight recorder subscribe here, so a rollback is *explainable* offline,
+    not just counted. A raising callback is swallowed: diagnostics must
+    never take down the recovery path they observe.
     """
 
-    def __init__(self, cfg: SentinelConfig, registry=None):
+    def __init__(self, cfg: SentinelConfig, registry=None, on_event=None):
         self.cfg = cfg
+        self.on_event = on_event
         reg = registry if registry is not None else get_registry()
         self._m_skipped = reg.counter(
             "train_steps_skipped_total",
@@ -102,6 +110,15 @@ class DivergenceSentinel:
         self.rollbacks = 0
         self.ema: float | None = None
 
+    def _notify(self, kind: str, **payload) -> None:
+        cb = self.on_event
+        if cb is None:
+            return
+        try:
+            cb(kind, payload)
+        except Exception:  # noqa: BLE001 - diagnostics never break recovery
+            pass
+
     def observe(self, step: int, metrics: dict) -> bool:
         """Digest one step's host-fetched metrics; True → roll back now."""
         skipped = float(metrics.get("skipped", 0.0)) >= 0.5
@@ -109,6 +126,13 @@ class DivergenceSentinel:
         if skipped or not math.isfinite(loss):
             self._m_skipped.inc()
             self.bad_streak += 1
+            self._notify(
+                "bad_step",
+                step=step,
+                loss=loss,
+                reason="device_skip" if skipped else "nonfinite_loss",
+                streak=self.bad_streak,
+            )
             return self.bad_streak >= self.cfg.patience
         if (
             self.ema is not None
@@ -117,6 +141,13 @@ class DivergenceSentinel:
         ):
             self._m_spikes.inc()
             self.bad_streak += 1
+            self._notify(
+                "loss_spike",
+                step=step,
+                loss=loss,
+                ema=self.ema,
+                streak=self.bad_streak,
+            )
             # a spike still carries signal — let the EMA drift toward it so
             # a legitimate regime change stops counting as bad eventually
             self._update_ema(loss)
@@ -136,6 +167,11 @@ class DivergenceSentinel:
         self._m_rollbacks.inc()
         self.bad_streak = 0
         self.ema = None
+        self._notify(
+            "rollback",
+            rollbacks=self.rollbacks,
+            max_rollbacks=self.cfg.max_rollbacks,
+        )
         if self.rollbacks > self.cfg.max_rollbacks:
             raise DivergenceError(
                 f"training diverged {self.rollbacks} times "

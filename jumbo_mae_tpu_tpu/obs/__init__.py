@@ -8,12 +8,32 @@
   chrome-trace export, and the XLA device-trace capture helpers.
 - ``obs.mfu``      — analytic FLOPs + MFU reporting (fed into the registry
   by the train loop).
+- ``obs.modelstats`` — per-layer-group grad/param/update statistics computed
+  inside the jitted train step (``run.diag_every``).
+- ``obs.journal``  — append-only crash-safe JSONL run journal + reader.
+- ``obs.flightrec`` — crash flight recorder (ring buffer + black-box dumps).
 
 The former ``utils/meters.py`` / ``utils/mfu.py`` / ``utils/profiling.py``
 modules remain as import-compatible shims over this package.
 """
 
 from jumbo_mae_tpu_tpu.obs.exporter import HealthState, TelemetryServer
+from jumbo_mae_tpu_tpu.obs.flightrec import FlightRecorder
+from jumbo_mae_tpu_tpu.obs.journal import (
+    RunJournal,
+    env_fingerprint,
+    journal_dir,
+    read_journal,
+)
+from jumbo_mae_tpu_tpu.obs.modelstats import (
+    STAT_NAMES,
+    first_nonfinite_group,
+    group_layout,
+    group_of,
+    group_stats,
+    publish_group_stats,
+    stats_dict,
+)
 from jumbo_mae_tpu_tpu.obs.metrics import (
     LATENCY_BUCKETS,
     NULL_REGISTRY,
@@ -51,6 +71,7 @@ __all__ = [
     "AverageMeter",
     "Counter",
     "Family",
+    "FlightRecorder",
     "Gauge",
     "HealthState",
     "Histogram",
@@ -61,19 +82,30 @@ __all__ = [
     "NullRegistry",
     "PEAK_TFLOPS",
     "RATIO_BUCKETS",
+    "RunJournal",
+    "STAT_NAMES",
     "TelemetryServer",
     "annotate",
     "classify_flops_per_image",
     "detect_peak_tflops",
     "encoder_flops_per_image",
+    "env_fingerprint",
     "export_chrome_trace",
+    "first_nonfinite_group",
     "get_registry",
+    "group_layout",
+    "group_of",
+    "group_stats",
+    "journal_dir",
     "mfu_report",
     "pretrain_flops_per_image",
+    "publish_group_stats",
+    "read_journal",
     "set_registry",
     "span",
     "span_timer",
     "start_chrome_trace",
+    "stats_dict",
     "stop_chrome_trace",
     "trace",
 ]

@@ -21,6 +21,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry, get_registry
 
+# Process start (well, module import — the closest observable moment) for
+# process_uptime_seconds: a scrape-visible restart detector. A counter that
+# resets to ~0 tells the scraper "same target, new process" even when every
+# app-level counter happens to be small.
+_PROCESS_START = time.monotonic()
+
 
 class HealthState:
     """Thread-safe readiness + liveness state behind ``/healthz``.
@@ -108,6 +114,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
+            pre = getattr(self.server, "pre_scrape", None)
+            if pre is not None:
+                pre()  # refresh scrape-time gauges (uptime)
             body = self.server.registry.render().encode()
             self._reply(200, body, "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
@@ -132,6 +141,7 @@ class _Server(ThreadingHTTPServer):
     daemon_threads = True
     registry: MetricsRegistry
     health: HealthState
+    pre_scrape = None  # optional zero-arg callable run before each /metrics
 
 
 class TelemetryServer:
@@ -161,6 +171,30 @@ class TelemetryServer:
         httpd = _Server((self.host, self.port), _Handler)
         httpd.registry = self.registry
         httpd.health = self.health
+        # restart-distinguishing metadata: build_info{version,jax_version}=1
+        # (the Prometheus info-metric idiom) + a scrape-time-refreshed
+        # process uptime gauge. Registered at start() so a NullRegistry A/B
+        # stays no-op and import stays jax-free.
+        from jumbo_mae_tpu_tpu import __version__
+
+        try:
+            import jax
+
+            jax_version = jax.__version__
+        except Exception:  # noqa: BLE001 - exporter must work jax-less
+            jax_version = "unavailable"
+        self.registry.gauge(
+            "build_info",
+            "constant 1; the labels identify the running build",
+            labels=("version", "jax_version"),
+        ).labels(version=__version__, jax_version=jax_version).set(1)
+        g_uptime = self.registry.gauge(
+            "process_uptime_seconds",
+            "seconds since process start — a near-zero value means restart",
+        )
+        httpd.pre_scrape = lambda: g_uptime.set(
+            time.monotonic() - _PROCESS_START
+        )
         self.port = httpd.server_address[1]
         self._httpd = httpd
         self._thread = threading.Thread(

@@ -1,0 +1,240 @@
+"""Append-only, crash-safe JSONL run journal.
+
+The in-memory metrics registry dies with the process; the journal is the
+part of a run's history that *survives* — one fsync'd JSON line per event,
+written under ``<run_dir>/journal/``, readable offline by
+``tools/run_doctor.py`` long after the run (or the host) is gone.
+
+Event shape: every line is ``{"ts": epoch_s, "seq": n, "type": t, ...}``.
+The wired event types (free-form types are allowed):
+
+- ``run_start``        — full config dict + environment fingerprint
+- ``step``             — log-cadence metric snapshot (loss, grad_norm,
+  throughput, data-wait fraction, per-layer-group diag stats when enabled)
+- ``checkpoint_save``  — a checkpoint left the step loop
+- ``sentinel_bad_step`` / ``sentinel_loss_spike`` — per-step sentinel
+  verdicts (exact step indices, unlike the windowed ``step`` snapshots)
+- ``rollback``         — sentinel rollback: from/to steps, budget used
+- ``quarantine``       — shard URLs the retry layer gave up on
+- ``flight_record``    — a flight-recorder dump was written (with its path)
+- ``shutdown``         — how the run ended (completed / preempted /
+  exception / diverged)
+
+Crash-safety contract:
+
+- every ``event()`` is flushed AND fsync'd before returning — a SIGKILL
+  loses at most the line being written, never a prior one;
+- a torn final line (the process died mid-write) is *skipped* by
+  :func:`read_journal`, never an error;
+- rotation starts a new numbered segment (``journal-00001.jsonl`` …) and
+  never rewrites an old one; a restarted run opens a fresh segment, so a
+  torn tail can never be appended after.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+_SEGMENT_PREFIX = "journal-"
+_SEGMENT_SUFFIX = ".jsonl"
+
+
+def _json_default(obj):
+    """Journal payloads carry numpy scalars/arrays and Paths; make them JSON."""
+    import numpy as np
+
+    if isinstance(obj, (np.floating, np.integer)):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, Path):
+        return str(obj)
+    return repr(obj)
+
+
+def _sanitize(value):
+    """JSON refuses NaN/Inf under allow_nan=False; the journal must encode a
+    non-finite loss (it's the whole point) — stringify them."""
+    if isinstance(value, float):
+        if value != value:
+            return "nan"
+        if value in (float("inf"), float("-inf")):
+            return "inf" if value > 0 else "-inf"
+        return value
+    if isinstance(value, dict):
+        return {k: _sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_sanitize(v) for v in value]
+    return value
+
+
+class RunJournal:
+    """Writer half: fsync-per-line JSONL segments with size-based rotation.
+
+    Not thread-safe by design — events come from the single train loop
+    thread at log cadence (the fsync is the cost ceiling, not a lock).
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        max_bytes: int = 4 * 1024 * 1024,
+        keep: int = 64,
+        fsync: bool = True,
+    ):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.max_bytes = int(max_bytes)
+        self.keep = int(keep)
+        self.fsync = bool(fsync)
+        self._seq = 0
+        # a restarted run continues in a NEW segment after the highest
+        # existing index — an old torn tail stays torn, ordering by
+        # filename stays total
+        self._index = self._next_index()
+        self._file = open(self._segment_path(self._index), "a", encoding="utf-8")
+
+    def _next_index(self) -> int:
+        existing = sorted(self.directory.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}"))
+        if not existing:
+            return 0
+        last = existing[-1].name[len(_SEGMENT_PREFIX):-len(_SEGMENT_SUFFIX)]
+        try:
+            return int(last) + 1
+        except ValueError:  # foreign file matching the glob
+            return len(existing)
+
+    def _segment_path(self, index: int) -> Path:
+        return self.directory / f"{_SEGMENT_PREFIX}{index:05d}{_SEGMENT_SUFFIX}"
+
+    @property
+    def path(self) -> Path:
+        """The segment currently being appended to."""
+        return self._segment_path(self._index)
+
+    def event(self, etype: str, **fields) -> dict:
+        """Append one event; returns the record as written (post-sanitize)."""
+        rec = {
+            "ts": round(time.time(), 3),
+            "seq": self._seq,
+            "type": etype,
+            **_sanitize(fields),
+        }
+        line = json.dumps(
+            rec, default=_json_default, separators=(",", ":"), allow_nan=False
+        )
+        self._file.write(line + "\n")
+        self._file.flush()
+        if self.fsync:
+            os.fsync(self._file.fileno())
+        self._seq += 1
+        if self._file.tell() >= self.max_bytes:
+            self._rotate()
+        return rec
+
+    def _rotate(self) -> None:
+        self._file.close()
+        self._index += 1
+        self._file = open(self._segment_path(self._index), "a", encoding="utf-8")
+        # prune the oldest segments beyond the retention budget
+        segments = sorted(self.directory.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}"))
+        for old in segments[: max(0, len(segments) - self.keep)]:
+            try:
+                old.unlink()
+            except OSError:  # pragma: no cover - concurrent cleanup
+                pass
+
+    def close(self) -> None:
+        if not self._file.closed:
+            self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
+            self._file.close()
+
+    def __enter__(self) -> "RunJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def journal_dir(path: str | Path) -> Path | None:
+    """Resolve a user-supplied path (run dir, journal dir, or one segment
+    file) to the journal location, or None when there is no journal there."""
+    p = Path(path)
+    if p.is_file():
+        return p
+    if p.is_dir():
+        if list(p.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}")):
+            return p
+        sub = p / "journal"
+        if sub.is_dir() and list(sub.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}")):
+            return sub
+    return None
+
+
+def read_journal(path: str | Path) -> list[dict]:
+    """Reader half: every parseable event across all segments, in order.
+
+    Tolerates exactly the damage a crash can cause: a torn final line
+    (partial write + SIGKILL) is skipped; any other unparseable line is
+    skipped too rather than aborting the whole read — a diagnosis from 999
+    events beats an exception over 1. Raises ``FileNotFoundError`` only when
+    there is no journal at ``path`` at all.
+    """
+    loc = journal_dir(path)
+    if loc is None:
+        raise FileNotFoundError(f"no journal segments under {path}")
+    files = [loc] if loc.is_file() else sorted(
+        loc.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}")
+    )
+    events: list[dict] = []
+    for f in files:
+        text = f.read_bytes().decode("utf-8", errors="replace")
+        for line in text.split("\n"):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail or damaged line — skip, keep reading
+            if isinstance(rec, dict):
+                events.append(rec)
+    return events
+
+
+def env_fingerprint() -> dict:
+    """What was this process, exactly? Enough to tell two restarts apart and
+    to blame a config/environment change across a divergence boundary."""
+    import platform
+    import socket
+    import sys
+
+    from jumbo_mae_tpu_tpu import __version__
+
+    info = {
+        "version": __version__,
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+        "hostname": socket.gethostname(),
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+    }
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+        info["backend"] = jax.default_backend()
+        info["device_count"] = jax.device_count()
+        info["process_count"] = jax.process_count()
+    except Exception:  # noqa: BLE001 - fingerprint must never fail a run
+        info["jax"] = "unavailable"
+    for var in ("JAX_PLATFORMS", "GRAFT_FAULTS", "JUMBO_COMPILE_CACHE"):
+        if os.environ.get(var):
+            info.setdefault("env", {})[var] = os.environ[var]
+    return info

@@ -1,0 +1,192 @@
+"""On-device per-layer-group model statistics for training diagnostics.
+
+When a run diverges, the interesting question is not "did the loss go NaN"
+(the sentinel already answers that) but *where*: which part of the model
+blew up first, and was the update/param ratio drifting before it did. This
+module buckets every parameter leaf into a small set of named **layer
+groups** — ``patch_embed`` / ``cls`` / ``blocks.N`` / ``jumbo_mlp`` /
+``norm`` / ``decoder`` / ``head`` — and computes, *inside the jitted train
+step*, three numbers per group:
+
+- ``grad_norm``      — L2 norm of the group's gradients
+- ``param_norm``     — L2 norm of the group's parameters (pre-update)
+- ``update_ratio``   — ``||new - old|| / (||old|| + eps)``, the effective
+  per-group step size (the number that drifts upward before a blow-up)
+
+stacked into ONE ``(groups, 3)`` float32 array, so the host fetches a
+single small transfer per diagnostic step instead of a tree of scalars.
+The grouping itself is static Python over the pytree structure — it traces
+once and adds no dynamic work to the compiled program. With the step
+factory's ``diag`` flag off, none of this is traced and the base program's
+HLO is unchanged.
+
+Host side, :func:`publish_group_stats` turns the fetched array into labeled
+gauges in the PR-3 registry (``model_grad_norm{group=...}`` etc.) and
+:func:`stats_dict` into the nested dict the run journal / flight recorder
+store.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+# Column order of the stacked stats array.
+STAT_NAMES = ("grad_norm", "param_norm", "update_ratio")
+
+_METRIC_HELP = {
+    "grad_norm": "L2 gradient norm per layer group (diag steps only)",
+    "param_norm": "L2 parameter norm per layer group (diag steps only)",
+    "update_ratio": "||update|| / ||param|| per layer group (diag steps only)",
+}
+
+# Canonical group ordering for display/stacking: input side first, then the
+# transformer trunk, then the task-specific tails.
+_GROUP_RANK = {
+    "patch_embed": 0,
+    "cls": 1,
+    # blocks.N rank between cls and jumbo_mlp, ordered by N (see _order_key)
+    "jumbo_mlp": 3,
+    "norm": 4,
+    "head": 5,
+    "decoder": 6,
+    "other": 7,
+}
+
+
+def _path_names(key_path) -> list[str]:
+    """Flatten a jax key path into plain name strings."""
+    names = []
+    for k in key_path:
+        if hasattr(k, "key"):        # DictKey
+            names.append(str(k.key))
+        elif hasattr(k, "name"):     # GetAttrKey
+            names.append(str(k.name))
+        elif hasattr(k, "idx"):      # SequenceKey
+            names.append(str(k.idx))
+        else:  # pragma: no cover - future key kinds degrade to repr
+            names.append(str(k))
+    return names
+
+
+def group_of(path: list[str] | tuple[str, ...]) -> str:
+    """Map one parameter leaf path to its layer-group name.
+
+    Handles both model trees: MAE pretrain (``encoder/...`` + the
+    decoder-side leaves at top level) and classification (everything under
+    ``model/...`` including ``head``).
+    """
+    parts = list(path)
+    if parts and parts[0] in ("encoder", "model"):
+        parts = parts[1:]
+    if not parts:
+        return "other"
+    head = parts[0]
+    if head in ("decoder", "decoder_proj", "mask_token", "pixel_proj"):
+        return "decoder"
+    if head == "embed":
+        return "patch_embed"
+    if head.startswith("block_"):
+        return f"blocks.{head[len('block_'):]}"
+    if head == "cls_tokens":
+        return "cls"
+    if head == "jumbo_mlp":
+        return "jumbo_mlp"
+    if head == "head":
+        return "head"
+    if head == "ln":
+        return "norm"
+    return "other"
+
+
+def _order_key(name: str) -> tuple:
+    if name.startswith("blocks."):
+        try:
+            return (2, int(name.split(".", 1)[1]))
+        except ValueError:  # pragma: no cover - non-integer block suffix
+            return (2, 1 << 30)
+    return (_GROUP_RANK.get(name, 7), 0)
+
+
+def group_layout(params) -> tuple[str, ...]:
+    """The ordered tuple of group names present in ``params`` — the static
+    row layout of the stacked stats array."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    names = {group_of(_path_names(kp)) for kp, _ in leaves}
+    return tuple(sorted(names, key=_order_key))
+
+
+def group_stats(old_params, grads, new_params) -> jax.Array:
+    """Per-group (grad_norm, param_norm, update_ratio), stacked ``(G, 3)``.
+
+    Traced inside the train step: the grouping loop is Python-time, so the
+    compiled program only contains the per-leaf square-sums (which XLA fuses
+    with the update it already computes) and one tiny stack. Row order is
+    :func:`group_layout`'s; accumulate in float32 regardless of the stored
+    param dtype (bf16 square-sums lose mantissa fast).
+    """
+    path_leaves = jax.tree_util.tree_flatten_with_path(old_params)[0]
+    grad_leaves = jax.tree_util.tree_leaves(grads)
+    new_leaves = jax.tree_util.tree_leaves(new_params)
+    sums: dict[str, list] = {}
+    for (kp, p), g, n in zip(path_leaves, grad_leaves, new_leaves):
+        grp = group_of(_path_names(kp))
+        acc = sums.setdefault(grp, [jnp.float32(0), jnp.float32(0), jnp.float32(0)])
+        pf = p.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        df = n.astype(jnp.float32) - pf
+        acc[0] = acc[0] + jnp.sum(gf * gf)
+        acc[1] = acc[1] + jnp.sum(pf * pf)
+        acc[2] = acc[2] + jnp.sum(df * df)
+    rows = []
+    for grp in sorted(sums, key=_order_key):
+        g_sq, p_sq, u_sq = sums[grp]
+        p_norm = jnp.sqrt(p_sq)
+        rows.append(
+            jnp.stack([jnp.sqrt(g_sq), p_norm, jnp.sqrt(u_sq) / (p_norm + 1e-12)])
+        )
+    return jnp.stack(rows)
+
+
+def stats_dict(names: tuple[str, ...], array) -> dict[str, dict[str, float]]:
+    """Fetched ``(G, 3)`` array → ``{group: {stat: float}}`` (journal shape).
+
+    Non-finite values survive as the JSON-safe strings ``"nan"``/``"inf"``
+    so a blown-up group is still readable from a journal parsed by strict
+    JSON tooling.
+    """
+    arr = np.asarray(array, np.float64)
+    out: dict[str, dict[str, float]] = {}
+    for gi, grp in enumerate(names):
+        row = {}
+        for si, stat in enumerate(STAT_NAMES):
+            v = float(arr[gi, si])
+            row[stat] = v if np.isfinite(v) else ("nan" if np.isnan(v) else "inf")
+        out[grp] = row
+    return out
+
+
+def publish_group_stats(names: tuple[str, ...], array, registry=None) -> None:
+    """Push one fetched stats array into ``model_<stat>{group=...}`` gauges."""
+    reg = registry if registry is not None else get_registry()
+    arr = np.asarray(array, np.float64)
+    for si, stat in enumerate(STAT_NAMES):
+        fam = reg.gauge(f"model_{stat}", _METRIC_HELP[stat], labels=("group",))
+        for gi, grp in enumerate(names):
+            fam.labels(grp).set(float(arr[gi, si]))
+
+
+def first_nonfinite_group(
+    names: tuple[str, ...], array
+) -> str | None:
+    """The first group (in layout order) whose grad norm is non-finite in
+    one stats array — the "where did it blow up" readout ``run_doctor`` and
+    the flight recorder lead with. None when every group is finite."""
+    arr = np.asarray(array, np.float64)
+    for gi, grp in enumerate(names):
+        if not np.isfinite(arr[gi, 0]):
+            return grp
+    return None

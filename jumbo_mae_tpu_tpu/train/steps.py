@@ -36,6 +36,7 @@ import optax
 from jax.sharding import Mesh
 
 from jumbo_mae_tpu_tpu.faults.sentinel import guarded_apply_gradients
+from jumbo_mae_tpu_tpu.obs.modelstats import group_stats
 from jumbo_mae_tpu_tpu.parallel.sharding import (
     batch_sharding,
     infer_state_sharding,
@@ -134,6 +135,7 @@ def make_train_step(
     encoder_cfg: Any = None,
     decoder_cfg: Any = None,
     guard_nonfinite: bool = False,
+    diag: bool = False,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step.
 
@@ -154,6 +156,14 @@ def make_train_step(
     step's loss/grads NaN (``train.loss`` / ``train.grad`` sites) without
     triggering a compile; a multiply by exactly 1.0 is bit-exact in every
     float dtype, so un-injected runs are numerically identical.
+
+    ``diag=True`` (a STATIC flag — the ``diag=False`` program is untouched)
+    additionally compiles per-layer-group diagnostics into the step
+    (``obs/modelstats.py``): the metrics gain ``diag``, a ``(groups, 3)``
+    float32 array of (grad_norm, param_norm, update_ratio) per layer group
+    in :func:`~jumbo_mae_tpu_tpu.obs.modelstats.group_layout` order, and
+    ``finite_frac``, the finite fraction of the per-sample loss batch. The
+    host decides the fetch cadence (``run.diag_every``).
 
     ``pipe_microbatches > 0`` (requires ``encoder_cfg`` and a mesh with a
     ``pipe`` axis): the encoder's block chain runs through the GPipe
@@ -248,6 +258,13 @@ def make_train_step(
             for k, v in out.items()
             if not k.endswith("_per_sample")
         }
+        if diag:
+            # finite fraction of the loss batch: per-sample where the model
+            # exposes it (pretrain loss_per_sample, classify per-sample
+            # loss), else the scalar's own finiteness
+            ps = out.get("loss_per_sample", out["loss"])
+            fin = jnp.isfinite(ps).astype(jnp.float32)
+            metrics["finite_frac"] = fin.mean() if fin.ndim else fin
         return metrics["loss"] * loss_mult, (metrics, new_stats)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -315,6 +332,7 @@ def make_train_step(
         grads = jax.tree_util.tree_map(
             lambda g: g * grad_mult.astype(g.dtype), grads
         )
+        prev_params = state.params if diag else None
         if guard_nonfinite:
             # the guard must see the INJECTED loss (metrics keep the raw
             # one): raw_loss x loss_mult is exactly the differentiated value
@@ -339,6 +357,12 @@ def make_train_step(
             state = state.apply_gradients(grads=grads)
             if new_stats is not None:
                 state = state.replace(batch_stats=new_stats)
+        if diag:
+            # one stacked (groups, 3) array — a single small host fetch per
+            # diagnostic step instead of a tree of scalars
+            metrics = metrics | {
+                "diag": group_stats(prev_params, grads, state.params)
+            }
         hyper = getattr(state.opt_state, "hyperparams", None)
         if hyper is not None:
             metrics = metrics | {"learning_rate": hyper["learning_rate"]}

@@ -291,9 +291,12 @@ def _smoke_overrides(tmp_path, steps, extra=()):
 def test_rollback_recovers_where_unguarded_diverges(tmp_path, fault_plan):
     """E2E acceptance: NaN injected at steps 5-7. Guarded: the skids are
     skipped, the sentinel rolls back to the step-4 checkpoint, the run
-    finishes with a finite loss. Unguarded: params are poisoned and the
-    final loss is NaN."""
+    finishes with a finite loss — AND the incident is fully explainable
+    offline: the journal carries the rollback + per-step sentinel verdicts,
+    and the flight recorder left a black-box dump (PR 5). Unguarded: params
+    are poisoned and the final loss is NaN."""
     from jumbo_mae_tpu_tpu.cli.train import train
+    from jumbo_mae_tpu_tpu.obs.journal import read_journal
 
     skipped0 = counter_value("train_steps_skipped_total")
     rollbacks0 = counter_value("train_rollbacks_total")
@@ -317,6 +320,22 @@ def test_rollback_recovers_where_unguarded_diverges(tmp_path, fault_plan):
     assert math.isfinite(guarded["train/loss"])
     assert counter_value("train_steps_skipped_total") - skipped0 >= 3
     assert counter_value("train_rollbacks_total") - rollbacks0 == 1
+
+    # the rollback left a durable journal trail...
+    run_dir = tmp_path / "guarded" / "smoke_cpu"
+    events = read_journal(run_dir)
+    rb = [e for e in events if e["type"] == "rollback"]
+    assert len(rb) == 1 and rb[0]["to_step"] == 4
+    bad = [e["step"] for e in events if e["type"] == "sentinel_bad_step"]
+    assert set(bad) >= {5, 6, 7}  # exact injected steps, durably recorded
+    assert events[-1]["type"] == "shutdown"
+    # ...and a flight-record black box (dump journaled with its path)
+    dumps = sorted(run_dir.glob("flightrec-*-sentinel_rollback.json"))
+    assert dumps, "sentinel rollback left no flight-record dump"
+    assert any(
+        e["type"] == "flight_record" and e["reason"] == "sentinel_rollback"
+        for e in events
+    )
 
     faults.clear_plan()
     unguarded = train(

@@ -1,0 +1,516 @@
+"""Training-dynamics diagnostics: per-layer-group model stats, the crash-safe
+run journal, the flight recorder, and the offline run doctor.
+
+Covers the PR-5 acceptance surface:
+
+- param-leaf → layer-group mapping and the stacked on-device stats (values
+  checked against a numpy recompute);
+- ``make_train_step(diag=True)`` returns the ``(groups, 3)`` stats array +
+  ``finite_frac`` and does NOT retrace between calls; ``diag=False`` keeps
+  the metrics schema exactly as before (no diag keys anywhere);
+- journal crash-safety: a torn final line is skipped on read, mid-file
+  damage doesn't abort, rotation preserves ordering, restart opens a new
+  segment, non-finite floats survive the JSON round trip;
+- flight recorder: bounded ring, dump file shape, excepthook/signal
+  chaining installs and uninstalls cleanly;
+- ``tools/run_doctor.py`` exits 0 on a synthetic incident journal and names
+  the bad-step window and the first non-finite layer group;
+- exporter satellite: ``process_uptime_seconds`` + ``build_info`` appear on
+  a real scrape;
+- e2e: a short CPU train run with ``run.diag_every`` writes per-layer-group
+  snapshots into a journal the doctor can read back.
+"""
+
+import json
+import math
+import signal
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.models import DecoderConfig, MAEPretrainModel, preset
+from jumbo_mae_tpu_tpu.obs.flightrec import FlightRecorder
+from jumbo_mae_tpu_tpu.obs.journal import (
+    RunJournal,
+    env_fingerprint,
+    read_journal,
+)
+from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+from jumbo_mae_tpu_tpu.obs.modelstats import (
+    STAT_NAMES,
+    first_nonfinite_group,
+    group_layout,
+    group_of,
+    group_stats,
+    publish_group_stats,
+    stats_dict,
+)
+from jumbo_mae_tpu_tpu.parallel import MeshConfig, create_mesh
+from jumbo_mae_tpu_tpu.train import (
+    OptimConfig,
+    create_sharded_state,
+    make_optimizer,
+    make_train_step,
+)
+
+RECIPES = Path(__file__).resolve().parent.parent / "recipes"
+
+TINY = preset("vit_t16", image_size=32, patch_size=8, dtype="float32")
+TINY_DEC = DecoderConfig(layers=1, dim=32, heads=2, dtype="float32")
+OPT = OptimConfig(
+    name="adamw",
+    learning_rate=1e-3,
+    lr_scaling="none",
+    warmup_steps=2,
+    training_steps=20,
+)
+
+
+# ------------------------------------------------------------- model stats
+
+
+class TestGrouping:
+    def test_group_of_both_model_trees(self):
+        # MAE pretrain tree (encoder/... + decoder-side leaves at top level)
+        assert group_of(["encoder", "embed", "kernel"]) == "patch_embed"
+        assert group_of(["encoder", "block_3", "attn", "w"]) == "blocks.3"
+        assert group_of(["encoder", "cls_tokens"]) == "cls"
+        assert group_of(["encoder", "jumbo_mlp", "fc1"]) == "jumbo_mlp"
+        assert group_of(["encoder", "ln", "scale"]) == "norm"
+        for top in ("decoder", "decoder_proj", "mask_token", "pixel_proj"):
+            assert group_of([top, "x"]) == "decoder"
+        # classification tree (everything under model/, incl. head)
+        assert group_of(["model", "head", "kernel"]) == "head"
+        assert group_of(["model", "block_0", "mlp"]) == "blocks.0"
+        assert group_of(["something_else"]) == "other"
+
+    def test_group_layout_order_and_membership(self):
+        params = {
+            "encoder": {
+                "embed": {"k": np.ones(2)},
+                "block_0": {"k": np.ones(2)},
+                "block_1": {"k": np.ones(2)},
+                "cls_tokens": np.ones(2),
+                "jumbo_mlp": {"k": np.ones(2)},
+                "ln": {"s": np.ones(2)},
+            },
+            "decoder": {"k": np.ones(2)},
+            "mask_token": np.ones(2),
+        }
+        assert group_layout(params) == (
+            "patch_embed", "cls", "blocks.0", "blocks.1",
+            "jumbo_mlp", "norm", "decoder",
+        )
+
+    def test_group_stats_values_match_numpy(self):
+        old = {
+            "encoder": {
+                "embed": {"k": np.full((2, 3), 2.0, np.float32)},
+                "block_0": {"w": np.full((4,), 1.0, np.float32)},
+            },
+            "mask_token": np.full((3,), 0.5, np.float32),
+        }
+        grads = jax.tree_util.tree_map(lambda x: x * 0.1, old)
+        new = jax.tree_util.tree_map(lambda x, g: x - g, old, grads)
+        names = group_layout(old)
+        assert names == ("patch_embed", "blocks.0", "decoder")
+        arr = np.asarray(jax.jit(group_stats)(old, grads, new))
+        assert arr.shape == (3, 3)
+        for gi, (leaf, n) in enumerate(
+            [(old["encoder"]["embed"]["k"], 6), (old["encoder"]["block_0"]["w"], 4),
+             (old["mask_token"], 3)]
+        ):
+            g_norm = np.sqrt(np.sum((leaf * 0.1) ** 2))
+            p_norm = np.sqrt(np.sum(leaf**2))
+            np.testing.assert_allclose(arr[gi, 0], g_norm, rtol=1e-5)
+            np.testing.assert_allclose(arr[gi, 1], p_norm, rtol=1e-5)
+            # update == grad here, so ratio == g_norm / p_norm
+            np.testing.assert_allclose(arr[gi, 2], g_norm / p_norm, rtol=1e-5)
+
+    def test_stats_dict_and_nonfinite_group(self):
+        names = ("patch_embed", "decoder")
+        arr = np.array([[1.0, 2.0, 0.5], [np.nan, 1.0, 0.1]], np.float32)
+        d = stats_dict(names, arr)
+        assert d["patch_embed"]["grad_norm"] == pytest.approx(1.0)
+        assert d["decoder"]["grad_norm"] == "nan"  # JSON-safe encoding
+        assert first_nonfinite_group(names, arr) == "decoder"
+        assert first_nonfinite_group(names, np.ones((2, 3))) is None
+
+    def test_publish_group_stats_gauges(self):
+        reg = MetricsRegistry()
+        names = ("patch_embed", "blocks.0")
+        arr = np.array([[1.0, 2.0, 0.5], [3.0, 4.0, 0.75]])
+        publish_group_stats(names, arr, registry=reg)
+        for si, stat in enumerate(STAT_NAMES):
+            fam = reg.gauge(f"model_{stat}", labels=("group",))
+            assert fam.labels("patch_embed").value == pytest.approx(arr[0, si])
+            assert fam.labels("blocks.0").value == pytest.approx(arr[1, si])
+
+
+class TestTrainStepDiag:
+    """One compiled step serves every diag assertion (each build pays a full
+    jit compile — tier-1 budget). diag=False coverage rides on the whole of
+    ``test_train_steps.py``, which builds every step WITHOUT the flag and
+    pins the metrics schema — ``diag``/``finite_frac`` appearing there would
+    fail those tests, so the off-path needs no extra compile here."""
+
+    def test_diag_step_stats_no_retrace_and_nan_localization(self):
+        module = MAEPretrainModel(
+            TINY.replace(mask_ratio=0.75, labels=None), TINY_DEC
+        )
+        mesh = create_mesh(MeshConfig(data=1, fsdp=-1))
+        tx = make_optimizer(OPT, global_batch_size=256)
+        rng = np.random.RandomState(0)
+        batch = {
+            "images": jnp.asarray(
+                rng.randint(0, 256, (8, 32, 32, 3)).astype(np.uint8)
+            )
+        }
+        state, sharding = create_sharded_state(
+            module, tx, batch, mesh, mode="pretrain", init_seed=0, rng_seed=0
+        )
+        step = make_train_step(
+            mesh, sharding, mode="pretrain", guard_nonfinite=True, diag=True
+        )
+        names = group_layout(state.params)
+        assert "patch_embed" in names and "decoder" in names
+        state, metrics = step(state, batch)
+        assert metrics["diag"].shape == (len(names), len(STAT_NAMES))
+        arr = np.asarray(metrics["diag"])
+        assert np.all(np.isfinite(arr))
+        assert np.all(arr[:, 0] > 0)  # every group received gradient
+        # params are non-zero except zero-initialized groups (cls tokens)
+        zeroable = {"cls"}
+        for gi, grp in enumerate(names):
+            if grp not in zeroable:
+                assert arr[gi, 1] > 0, grp
+        assert float(metrics["finite_frac"]) == 1.0
+        # a clean second call reuses the same executable (no retrace)
+        state, m2 = step(state, batch)
+        assert m2["diag"].shape == arr.shape
+        # an injected-NaN call (traced input — still no retrace): NaN grads
+        # blow up every group's grad norm; the guard skipped the update so
+        # update_ratio stays 0 everywhere
+        _, m3 = step(state, batch, np.asarray([math.nan, math.nan], np.float32))
+        assert first_nonfinite_group(names, m3["diag"]) == names[0]
+        assert float(m3["skipped"]) == 1.0
+        np.testing.assert_allclose(np.asarray(m3["diag"])[:, 2], 0.0, atol=1e-12)
+
+
+# ------------------------------------------------------------------ journal
+
+
+class TestJournal:
+    def test_roundtrip_and_seq(self, tmp_path):
+        with RunJournal(tmp_path / "j") as j:
+            j.event("run_start", config={"a": 1})
+            j.event("step", step=5, loss=1.5)
+        evs = read_journal(tmp_path / "j")
+        assert [e["type"] for e in evs] == ["run_start", "step"]
+        assert [e["seq"] for e in evs] == [0, 1]
+        assert evs[1]["loss"] == 1.5
+        # reader also resolves the run dir (parent of journal/)
+        (tmp_path / "j").rename(tmp_path / "journal")
+        assert len(read_journal(tmp_path)) == 2
+
+    def test_nonfinite_values_survive(self, tmp_path):
+        with RunJournal(tmp_path / "j") as j:
+            j.event("step", loss=float("nan"), diag={"g": float("inf")})
+        e = read_journal(tmp_path / "j")[0]
+        assert e["loss"] == "nan" and e["diag"]["g"] == "inf"
+
+    def test_torn_final_line_skipped(self, tmp_path):
+        j = RunJournal(tmp_path / "j")
+        j.event("run_start")
+        j.event("step", step=1)
+        j.close()
+        # simulate SIGKILL mid-write: a partial JSON line at the tail
+        with open(j.path, "a") as f:
+            f.write('{"ts": 1.0, "seq": 2, "type": "step", "st')
+        evs = read_journal(tmp_path / "j")
+        assert [e["type"] for e in evs] == ["run_start", "step"]
+
+    def test_mid_file_damage_does_not_abort(self, tmp_path):
+        j = RunJournal(tmp_path / "j")
+        j.event("a")
+        j.event("b")
+        j.close()
+        text = j.path.read_text().splitlines()
+        text.insert(1, "GARBAGE NOT JSON")
+        j.path.write_text("\n".join(text) + "\n")
+        assert [e["type"] for e in read_journal(tmp_path / "j")] == ["a", "b"]
+
+    def test_rotation_preserves_ordering(self, tmp_path):
+        j = RunJournal(tmp_path / "j", max_bytes=200, keep=50)
+        for i in range(30):
+            j.event("step", step=i)
+        j.close()
+        segments = sorted((tmp_path / "j").glob("journal-*.jsonl"))
+        assert len(segments) > 1  # actually rotated
+        evs = read_journal(tmp_path / "j")
+        assert [e["step"] for e in evs] == list(range(30))
+        assert [e["seq"] for e in evs] == list(range(30))
+
+    def test_rotation_prunes_to_keep(self, tmp_path):
+        j = RunJournal(tmp_path / "j", max_bytes=120, keep=2)
+        for i in range(40):
+            j.event("step", step=i)
+        j.close()
+        segments = sorted((tmp_path / "j").glob("journal-*.jsonl"))
+        assert len(segments) <= 3  # keep=2 closed + 1 active
+        # the SURVIVING events are still in order
+        steps = [e["step"] for e in read_journal(tmp_path / "j")]
+        assert steps == sorted(steps)
+
+    def test_restart_opens_new_segment(self, tmp_path):
+        j1 = RunJournal(tmp_path / "j")
+        j1.event("run_start")
+        j1.close()
+        j2 = RunJournal(tmp_path / "j")
+        j2.event("run_start", restart=True)
+        j2.close()
+        assert j2.path != j1.path
+        evs = read_journal(tmp_path / "j")
+        assert len(evs) == 2 and evs[1].get("restart") is True
+
+    def test_missing_journal_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            read_journal(tmp_path / "nope")
+
+    def test_env_fingerprint_keys(self):
+        fp = env_fingerprint()
+        assert {"version", "python", "hostname", "pid", "jax"} <= set(fp)
+
+
+# ----------------------------------------------------------- flight recorder
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_dump_shape(self, tmp_path):
+        fr = FlightRecorder(tmp_path, capacity=4, event_capacity=2)
+        for i in range(10):
+            fr.record_step(i, {"loss": float(i)})
+        fr.record_event({"type": "a"})
+        fr.record_event({"type": "b"})
+        fr.record_event({"type": "c"})
+        path = fr.dump("nonfinite_step", extra={"bad_steps": [7]})
+        data = json.loads(path.read_text())
+        assert data["reason"] == "nonfinite_step"
+        assert [s["step"] for s in data["steps"]] == [6, 7, 8, 9]
+        assert [e["type"] for e in data["events"]] == ["b", "c"]
+        assert data["extra"]["bad_steps"] == [7]
+        assert path.name.startswith("flightrec-") and path.suffix == ".json"
+
+    def test_nonfinite_payloads_dump_cleanly(self, tmp_path):
+        fr = FlightRecorder(tmp_path)
+        fr.record_step(1, {"loss": float("nan")})
+        data = json.loads(fr.dump("x").read_text())
+        assert data["steps"][0]["loss"] == "nan"
+
+    def test_each_dump_is_a_new_file(self, tmp_path):
+        fr = FlightRecorder(tmp_path)
+        p1, p2 = fr.dump("a"), fr.dump("a")
+        assert p1 != p2 and p1.exists() and p2.exists()
+        assert fr.dumps == [str(p1), str(p2)]
+
+    def test_excepthook_chains_and_uninstalls(self, tmp_path):
+        fr = FlightRecorder(tmp_path)
+        seen = []
+        orig = sys.excepthook
+        sys.excepthook = lambda *a: seen.append(a)
+        try:
+            fr.install(signals=())
+            sys.excepthook(ValueError, ValueError("boom"), None)
+            assert len(seen) == 1  # chained through
+            assert any("exception" in d for d in fr.dumps)
+            fr.uninstall()
+            assert sys.excepthook is not fr._excepthook
+        finally:
+            sys.excepthook = orig
+
+    def test_sigterm_handler_chains_to_previous(self, tmp_path):
+        fr = FlightRecorder(tmp_path)
+        hits = []
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+            assert fr.install()
+            handler = signal.getsignal(signal.SIGTERM)
+            handler(signal.SIGTERM, None)  # invoke without killing pytest
+            assert hits == [signal.SIGTERM]  # previous handler still ran
+            assert any("signal" in d for d in fr.dumps)
+            fr.uninstall()
+            assert signal.getsignal(signal.SIGTERM) not in (handler,)
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_atexit_fallback_only_when_abnormal_and_undumped(self, tmp_path):
+        fr = FlightRecorder(tmp_path)
+        fr.record_step(1, {"loss": 1.0})
+        fr._atexit()  # clean run: nothing written
+        assert not list(tmp_path.glob("flightrec-*.json"))
+        fr.mark_abnormal()
+        fr._atexit()
+        assert len(list(tmp_path.glob("flightrec-*.json"))) == 1
+        fr._atexit()  # already dumped: no duplicate
+        assert len(list(tmp_path.glob("flightrec-*.json"))) == 1
+
+
+# --------------------------------------------------------------- run doctor
+
+
+def _synthetic_incident_journal(tmp_path: Path) -> Path:
+    j = RunJournal(tmp_path / "journal")
+    j.event(
+        "run_start",
+        config={"run": {"name": "t", "mode": "pretrain", "training_steps": 12,
+                        "train_batch_size": 16}},
+        env={"python": "3.10", "jax": "0.4", "backend": "cpu",
+             "device_count": 1, "hostname": "h", "pid": 1},
+        start_step=0,
+        diag_every=1,
+        diag_groups=["patch_embed", "jumbo_mlp", "decoder"],
+    )
+    for s in (1, 2, 3, 4):
+        j.event(
+            "step", step=s,
+            metrics={"train/loss": 1.0, "train/grad_norm": 0.3 + 0.01 * s,
+                     "perf/images_per_sec": 300.0},
+            data_wait_fraction=0.05,
+        )
+    for s in (5, 6, 7):
+        j.event("sentinel_bad_step", step=s, loss="nan",
+                reason="device_skip", streak=s - 4)
+    j.event(
+        "step", step=7,
+        metrics={"train/loss": "nan"},
+        data_wait_fraction=0.04,
+        bad_steps=[5, 6, 7],
+        diag_step=7,
+        diag={"patch_embed": {"grad_norm": "nan", "param_norm": 1.0,
+                              "update_ratio": 0.0},
+              "jumbo_mlp": {"grad_norm": 2.0, "param_norm": 3.0,
+                            "update_ratio": 0.001},
+              "decoder": {"grad_norm": 1.0, "param_norm": 2.0,
+                          "update_ratio": 0.001}},
+    )
+    j.event("rollback", from_step=7, to_step=4, rollbacks=1, bad_steps=[5, 6, 7])
+    j.event("flight_record", reason="sentinel_rollback", path="x.json")
+    j.event("quarantine", shards=["s3.tar"])
+    j.event("shutdown", reason="completed", step=12)
+    j.close()
+    return tmp_path
+
+
+class TestRunDoctor:
+    def test_exit_zero_and_names_incident(self, tmp_path, capsys):
+        import tools.run_doctor as doctor
+
+        run_dir = _synthetic_incident_journal(tmp_path)
+        out = tmp_path / "report.md"
+        assert doctor.main([str(run_dir), "--out", str(out)]) == 0
+        report = out.read_text()
+        assert "steps 5–7" in report        # the injected fault window
+        assert "patch_embed" in report      # the first non-finite group
+        assert "1 sentinel rollback" in report
+        assert "quarantined" in report
+        assert "completed" in report
+
+    def test_exit_two_without_journal(self, tmp_path):
+        import tools.run_doctor as doctor
+
+        assert doctor.main([str(tmp_path)]) == 2
+
+    def test_tolerates_torn_journal(self, tmp_path):
+        import tools.run_doctor as doctor
+
+        run_dir = _synthetic_incident_journal(tmp_path)
+        seg = sorted((run_dir / "journal").glob("journal-*.jsonl"))[-1]
+        with open(seg, "a") as f:
+            f.write('{"torn": tr')
+        assert doctor.main([str(run_dir)]) == 0
+
+    def test_healthy_run_reports_no_incidents(self, tmp_path, capsys):
+        import tools.run_doctor as doctor
+
+        j = RunJournal(tmp_path / "journal")
+        j.event("run_start", config={}, env={}, start_step=0)
+        j.event("step", step=5, metrics={"train/loss": 0.9})
+        j.event("shutdown", reason="completed", step=5)
+        j.close()
+        assert doctor.main([str(tmp_path)]) == 0
+        assert "no incidents recorded" in capsys.readouterr().out
+
+
+# ------------------------------------------------------- exporter satellite
+
+
+def test_exporter_uptime_and_build_info(tmp_path):
+    import urllib.request
+
+    from jumbo_mae_tpu_tpu import __version__
+    from jumbo_mae_tpu_tpu.obs.exporter import TelemetryServer
+
+    reg = MetricsRegistry()
+    with TelemetryServer(registry=reg, host="127.0.0.1", port=0) as srv:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        ).read().decode()
+    assert "process_uptime_seconds" in body
+    # a scrape refreshes the value: it must be > 0 once rendered
+    line = next(
+        ln for ln in body.splitlines()
+        if ln.startswith("process_uptime_seconds ")
+    )
+    assert float(line.split()[-1]) > 0
+    assert f'build_info{{version="{__version__}"' in body
+    assert "jax_version=" in body
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def test_train_run_writes_diag_journal(tmp_path):
+    """Acceptance: a short CPU run with run.diag_every > 0 produces a journal
+    whose step snapshots carry per-layer-group grad/param norms, and the
+    doctor reads it back with exit 0."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+    from jumbo_mae_tpu_tpu.config import load_config
+
+    import tools.run_doctor as doctor
+
+    cfg = load_config(
+        RECIPES / "smoke_cpu.yaml",
+        [
+            f"run.output_dir={tmp_path}",
+            "run.training_steps=4",
+            "optim.training_steps=4",
+            "optim.warmup_steps=2",
+            "run.log_interval=2",
+            "run.eval_interval=4",
+            "run.sanity_eval=false",
+            "run.diag_every=2",
+        ],
+    )
+    metrics = train(cfg)
+    assert math.isfinite(metrics["train/loss"])
+    run_dir = tmp_path / "smoke_cpu"
+    evs = read_journal(run_dir)
+    types = [e["type"] for e in evs]
+    assert types[0] == "run_start" and types[-1] == "shutdown"
+    assert evs[-1]["reason"] == "completed"
+    step_evs = [e for e in evs if e["type"] == "step" and "diag" in e]
+    assert step_evs, "no diag-bearing step snapshots in the journal"
+    diag = step_evs[-1]["diag"]
+    assert "patch_embed" in diag and "decoder" in diag
+    for stats in diag.values():
+        assert set(stats) == set(STAT_NAMES)
+        assert stats["grad_norm"] > 0
+    assert diag["patch_embed"]["param_norm"] > 0
+    # finite_frac flowed through the meter into the logged summary
+    assert step_evs[-1]["metrics"]["train/finite_frac"] == 1.0
+    assert doctor.main([str(run_dir)]) == 0

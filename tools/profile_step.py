@@ -8,6 +8,12 @@ the table PERF.md's "Where a step goes" is built from, as one command:
 
     python tools/profile_step.py --model vit_h14 --steps 5 --out /tmp/h14
 
+Capture runs through the ``obs/trace.py`` helpers (the same ones
+``run.profile_dir`` / ``run.chrome_trace`` use), so alongside the XLA
+device trace it writes a host-side span timeline
+(``<out>/host_spans.trace.json``) in the SAME chrome-trace format as a
+training run's ``run.chrome_trace`` — one toolchain opens both.
+
 The reference had no profiling surface at all (SURVEY §5).
 """
 
@@ -24,8 +30,18 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from jumbo_mae_tpu_tpu.obs.trace import (  # noqa: E402
+    export_chrome_trace,
+    span_timer,
+    start_chrome_trace,
+    trace,
+)
 
-def capture(model: str, steps: int, out_dir: str, batch: int | None) -> str:
+
+def capture(
+    model: str, steps: int, out_dir: str, batch: int | None
+) -> tuple[str, str]:
+    """Returns ``(device_trace_path, host_span_trace_path)``."""
     import jax
 
     import bench
@@ -40,18 +56,28 @@ def capture(model: str, steps: int, out_dir: str, batch: int | None) -> str:
         state, metrics = step(state, batch_dev)
     jax.block_until_ready(metrics["loss"])
 
-    jax.profiler.start_trace(out_dir)
-    for _ in range(steps):
-        state, metrics = step(state, batch_dev)
-    jax.block_until_ready(metrics["loss"])
-    jax.profiler.stop_trace()
+    # device trace + host spans through the shared obs/trace helpers: the
+    # span timeline (dispatch per step, then the sync) lands in the same
+    # chrome-trace JSON shape run.chrome_trace produces
+    start_chrome_trace()
+    sp_step = span_timer("profile_step")
+    sp_sync = span_timer("block_until_ready")
+    with trace(out_dir):
+        for _ in range(steps):
+            with sp_step:
+                state, metrics = step(state, batch_dev)
+        with sp_sync:
+            jax.block_until_ready(metrics["loss"])
+    host_trace = export_chrome_trace(
+        os.path.join(out_dir, "host_spans.trace.json")
+    )
 
     traces = glob.glob(
         os.path.join(out_dir, "**", "*.trace.json.gz"), recursive=True
     )
     if not traces:
         raise FileNotFoundError(f"no trace written under {out_dir}")
-    return max(traces, key=os.path.getmtime)
+    return max(traces, key=os.path.getmtime), str(host_trace)
 
 
 def aggregate(trace_path: str, steps: int) -> tuple[dict, list, list, list]:
@@ -141,7 +167,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    path = args.trace or capture(args.model, args.steps, args.out, args.batch)
+    host_path = None
+    if args.trace:
+        path = args.trace
+    else:
+        path, host_path = capture(args.model, args.steps, args.out, args.batch)
     by_cat, top_ops, top_src, top_tf = aggregate(path, args.steps)
     total = sum(by_cat.values())
     print(f"\ndevice time by hlo_category (ms/step, {args.steps} steps):")
@@ -161,6 +191,8 @@ def main(argv: list[str] | None = None) -> int:
         gb = nbytes / secs / 1e9 if secs else 0.0
         print(f"  {us / 1e3 / args.steps:8.2f} ms {tf:7.1f} TF/s {gb:7.0f} GB/s  {op[:85]}")
     print(f"\ntrace: {path}")
+    if host_path:
+        print(f"host spans (chrome-trace, same format as run.chrome_trace): {host_path}")
     return 0
 
 
