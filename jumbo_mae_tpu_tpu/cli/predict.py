@@ -83,6 +83,40 @@ def build_parser() -> argparse.ArgumentParser:
         help="submit images one-by-one through the micro-batching queue",
     )
     p.add_argument(
+        "--warmup",
+        action="store_true",
+        help="pre-compile every (task, bucket) executable before the first "
+        "request, so request latencies measure serving, not compilation",
+    )
+    p.add_argument(
+        "--access-log",
+        default="",
+        metavar="DIR",
+        help="--serve: write a crash-safe JSONL access log (one row per "
+        "finished request) into DIR; read it back with tools/serve_doctor.py",
+    )
+    p.add_argument(
+        "--slo",
+        default=None,
+        metavar="SPEC",
+        help="--serve SLO objectives, e.g. 'p99_latency_ms<=250;"
+        "success_rate>=0.99' (default: run.slo from the recipe); breaches "
+        "latch the degraded flag in /healthz and the slo_* gauges",
+    )
+    p.add_argument(
+        "--slo-window-s",
+        type=float,
+        default=None,
+        help="SLO rolling window seconds (default: run.slo_window_s)",
+    )
+    p.add_argument(
+        "--slo-fast-window-s",
+        type=float,
+        default=None,
+        help="SLO fast confirmation window seconds "
+        "(default: run.slo_fast_window_s; 0 = window/12)",
+    )
+    p.add_argument(
         "--dtype",
         default=None,
         help="serving compute dtype override (e.g. float32 for the exact path)",
@@ -144,10 +178,63 @@ def main(argv: list[str] | None = None) -> Path | None:
     )
     if args.ckpt == "":
         print("[predict] WARNING: no --ckpt — serving a random init")
+    if args.warmup:
+        n_compiles = engine.warmup((args.task,), pool=args.pool)
+        print(f"[predict] warmup: {n_compiles} executable(s) compiled")
     if health is not None:
         health.set_ready(
             True, detail=f"engine up (ckpt={'yes' if args.ckpt else 'random'})"
         )
+
+    # request observability (obs/reqtrace.py, obs/slo.py) rides the serving
+    # path only — the direct batch path stays telemetry-free
+    tracer = None
+    slo_tracker = None
+    if args.serve:
+        from jumbo_mae_tpu_tpu.obs import (
+            AccessLog,
+            RequestTracer,
+            SLOTracker,
+            parse_slo,
+        )
+
+        slo_spec = args.slo if args.slo is not None else cfg.run.slo
+        if slo_spec:
+            slo_tracker = SLOTracker(
+                parse_slo(slo_spec),
+                window_s=(
+                    args.slo_window_s
+                    if args.slo_window_s is not None
+                    else cfg.run.slo_window_s
+                ),
+                fast_window_s=(
+                    args.slo_fast_window_s
+                    if args.slo_fast_window_s is not None
+                    else cfg.run.slo_fast_window_s
+                ),
+                burn_threshold=cfg.run.slo_burn_threshold,
+            )
+            print(
+                f"[predict] SLO: {slo_spec} over "
+                f"{slo_tracker.window_s:g}s/{slo_tracker.fast_window_s:g}s windows"
+            )
+        access = AccessLog(args.access_log) if args.access_log else None
+        if access is not None:
+            print(f"[predict] access log -> {access.path}")
+        if access is not None or slo_tracker is not None or telemetry is not None:
+            tracer = RequestTracer(
+                access_log=access,
+                breakdown=engine.last_breakdown,
+                on_finish=(
+                    slo_tracker.observe_trace if slo_tracker is not None else None
+                ),
+            )
+        if slo_tracker is not None:
+            if health is not None:
+                health.degraded_when(slo_tracker.degraded)
+                health.probe("slo", slo_tracker.healthz_info)
+            if telemetry is not None:
+                telemetry.add_pre_scrape(slo_tracker.evaluate)
 
     size = engine.image_size
     if args.synthetic:
@@ -188,7 +275,21 @@ def main(argv: list[str] | None = None) -> Path | None:
             max_batch=args.max_batch,
             max_delay_ms=args.max_delay_ms,
             max_queue=args.max_queue,
+            tracer=tracer,
+            task=args.task,
         ) as mb:
+            if health is not None:
+                # live autoscaler snapshot (queue depth / occupancy / shed
+                # rate) in the /healthz info payload while serving
+                health.probe("serving", mb.stats)
+            if slo_tracker is not None:
+                # ...and the same signals as slo_* gauges per scrape
+                slo_tracker.add_probe(
+                    "queue_depth", lambda: mb.stats()["queue_depth"]
+                )
+                slo_tracker.add_probe(
+                    "batch_occupancy", lambda: mb.stats()["batch_occupancy"]
+                )
             rows = [
                 f.result()
                 for f in [
@@ -202,6 +303,21 @@ def main(argv: list[str] | None = None) -> Path | None:
             else np.stack(rows)
         )
         print(f"[predict] micro-batch sizes: {mb.batch_sizes}")
+        if slo_tracker is not None:
+            rep = slo_tracker.evaluate()
+            objs = "; ".join(
+                f"{o['name']}: value={o['value']:g} "
+                f"burn={o['burn_slow']:g} breached={o['breached']}"
+                for o in rep["objectives"]
+            )
+            print(
+                f"[predict] SLO verdict: degraded={rep['degraded']} "
+                f"shed_rate={rep['shed_rate']:g} — {objs}"
+            )
+            if tracer is not None:
+                tracer.event("slo_summary", report=rep)
+        if tracer is not None:
+            tracer.close()
     else:
         out = engine.predict(images, task=args.task, **kw)
 

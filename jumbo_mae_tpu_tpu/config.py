@@ -114,6 +114,15 @@ class RunConfig:
     telemetry: bool = False
     telemetry_port: int = 9100
     telemetry_host: str = "0.0.0.0"
+    # serving SLOs (jumbo_mae_tpu_tpu/obs/slo.py): objectives like
+    # "p99_latency_ms<=250;success_rate>=0.99" evaluated over a rolling
+    # slow window with a fast confirmation window (0 = window_s / 12);
+    # breaches above burn_threshold latch the degraded flag in /healthz
+    # and publish the slo_* gauges. Empty = no SLO tracking.
+    slo: str = ""
+    slo_window_s: float = 60.0
+    slo_fast_window_s: float = 0.0
+    slo_burn_threshold: float = 1.0
     # write the host-side span timeline (chrome://tracing / Perfetto JSON)
     # here at the end of the run; complements profile_dir's XLA device trace
     chrome_trace: str = ""

@@ -28,6 +28,16 @@ a per-request ``deadline_ms`` expires queued requests with
 stale request occupy a batch slot; and :meth:`close` resolves every pending
 future with :class:`ShutdownError` — a ``submit()`` caller can never block
 forever on a batcher that is shutting down.
+
+With a :class:`~jumbo_mae_tpu_tpu.obs.reqtrace.RequestTracer` attached,
+every request carries a trace context from the first line of ``submit()``
+to its terminal outcome (``ok|shed|deadline|aborted|shutdown``) — per-
+request queue wait, coalescing wait, compute/fetch split, batch/bucket/pad
+— into ``request_*`` histograms and the JSONL access log. The trace begins
+*before* the ``serve.submit`` fault point so injected submit stalls show up
+as queue wait, exactly where the caller felt them. A trace is always
+finished before its future resolves, so an access-log row exists for every
+resolved future. Without a tracer every hook site is a ``None`` check.
 """
 
 from __future__ import annotations
@@ -80,6 +90,8 @@ class MicroBatcher:
         max_delay_ms: float = 5.0,
         max_queue: int | None = None,
         registry=None,
+        tracer=None,
+        task: str = "",
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -90,6 +102,8 @@ class MicroBatcher:
         self.max_delay = float(max_delay_ms) / 1000.0
         self.max_queue = max_queue
         self.batch_sizes: list[int] = []
+        self._tracer = tracer  # obs.reqtrace.RequestTracer | None
+        self.task = task
         # serving telemetry (obs/metrics.py): submit→result latency is THE
         # operator number — it includes coalescing wait, queueing behind
         # in-flight batches, and the forward itself
@@ -129,6 +143,8 @@ class MicroBatcher:
         )
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._depth = 0               # submitted, not yet popped by the loop
+        self._submitted = 0           # lifetime submit attempts (incl. sheds)
+        self._shed_n = 0              # lifetime QueueFullError sheds
         self._depth_lock = threading.Lock()
         self._closed = False
         self._drain = True
@@ -149,19 +165,43 @@ class MicroBatcher:
         requests are already pending (shed, don't buffer). With
         ``deadline_ms``, a request still queued that long after submit is
         failed with :class:`DeadlineExceededError` instead of occupying a
-        slot in a batch.
+        slot in a batch. With a tracer attached the returned future carries
+        the request id as ``fut.rid``.
         """
-        fault_point("serve.submit")
-        if self._closed:
-            raise RuntimeError("MicroBatcher is closed")
-        with self._depth_lock:
-            if self.max_queue is not None and self._depth >= self.max_queue:
-                self._m_shed.inc()
-                raise QueueFullError(
-                    f"request queue full ({self._depth}/{self.max_queue})"
-                )
-            self._depth += 1
+        # trace begins before the fault point: an injected submit stall is
+        # queue wait the caller experienced, and must be visible as such
+        tr = (
+            self._tracer.begin(task=self.task, deadline_ms=deadline_ms)
+            if self._tracer is not None
+            else None
+        )
+        try:
+            fault_point("serve.submit")
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            with self._depth_lock:
+                self._submitted += 1
+                if self.max_queue is not None and self._depth >= self.max_queue:
+                    self._m_shed.inc()
+                    self._shed_n += 1
+                    raise QueueFullError(
+                        f"request queue full ({self._depth}/{self.max_queue})"
+                    )
+                self._depth += 1
+        except BaseException as e:  # noqa: BLE001 — classify, trace, re-raise
+            if tr is not None:
+                if isinstance(e, QueueFullError):
+                    self._tracer.finish(tr, "shed")
+                elif self._closed:
+                    self._tracer.finish(tr, "shutdown")
+                else:
+                    self._tracer.finish(
+                        tr, "aborted", error=f"{type(e).__name__}: {e}"
+                    )
+            raise
         fut: Future = Future()
+        if tr is not None:
+            fut.rid = tr.rid
         deadline = (
             None
             if deadline_ms is None
@@ -170,12 +210,31 @@ class MicroBatcher:
         # submit stays latency-metric-free (counted batch-at-a-time in
         # _flush): at CPU-smoke request rates even one observe per submit
         # is measurable; the depth lock above is one uncontended acquire
-        self._q.put((np.asarray(image), fut, time.perf_counter(), deadline))
+        self._q.put((np.asarray(image), fut, time.perf_counter(), deadline, tr))
         return fut
 
     def __call__(self, image: np.ndarray, *, deadline_ms: float | None = None):
         """Blocking convenience: submit and wait."""
         return self.submit(image, deadline_ms=deadline_ms).result()
+
+    def stats(self) -> dict:
+        """Live serving snapshot — the autoscaler inputs ROADMAP §2 names,
+        shaped for ``HealthState.probe()`` / ``SLOTracker`` probes."""
+        with self._depth_lock:
+            depth = self._depth
+            submitted = self._submitted
+            shed = self._shed_n
+        sizes = self.batch_sizes
+        last = sizes[-1] if sizes else 0
+        mean = sum(sizes) / len(sizes) if sizes else 0.0
+        return {
+            "queue_depth": depth,
+            "batch_occupancy": round(last / self.max_batch, 4),
+            "mean_batch_occupancy": round(mean / self.max_batch, 4),
+            "requests_submitted": submitted,
+            "requests_shed": shed,
+            "shed_rate": round(shed / submitted, 4) if submitted else 0.0,
+        }
 
     def close(self, drain: bool = True):
         """Stop the collector and resolve EVERY pending request — no caller
@@ -192,12 +251,22 @@ class MicroBatcher:
             self._q.put(_STOP)
             self._thread.join()
             # sweep whatever the loop never popped (items enqueued behind
-            # the stop sentinel by racing submits)
+            # the stop sentinel by racing submits). An empty queue is NOT
+            # proof we're done: a racing submit increments _depth before its
+            # put(), so depth > 0 means an item is in — or about to enter —
+            # the queue; keep sweeping until depth drains (bounded, so a
+            # depth-accounting bug can't hang close forever).
+            sweep_deadline = time.monotonic() + 5.0
             while True:
                 try:
                     item = self._q.get_nowait()
                 except queue.Empty:
-                    break
+                    with self._depth_lock:
+                        depth = self._depth
+                    if depth <= 0 or time.monotonic() > sweep_deadline:
+                        break
+                    time.sleep(0.001)
+                    continue
                 if item is _STOP:
                     continue
                 self._dec()
@@ -217,6 +286,10 @@ class MicroBatcher:
 
     def _abort(self, item):
         self._m_aborted.inc()
+        if item[4] is not None:
+            # trace finishes before the future resolves: a caller that saw
+            # its future done can rely on the access-log row existing
+            self._tracer.finish(item[4], "shutdown")
         item[1].set_exception(ShutdownError("MicroBatcher closed"))
 
     def _admit(self, item, batch) -> None:
@@ -228,10 +301,14 @@ class MicroBatcher:
         dl = item[3]
         if dl is not None and time.monotonic() > dl:
             self._m_expired.inc()
+            if item[4] is not None:
+                self._tracer.finish(item[4], "deadline")
             item[1].set_exception(
                 DeadlineExceededError("request deadline passed while queued")
             )
             return
+        if item[4] is not None:
+            self._tracer.admitted(item[4])
         batch.append(item)
 
     def _loop(self):
@@ -267,20 +344,33 @@ class MicroBatcher:
         self._m_batches.inc()
         self._m_requests.inc(len(batch))
         self._m_occupancy.observe(len(batch) / self.max_batch)
+        traces = [it[4] for it in batch if it[4] is not None]
+        if traces:
+            self._tracer.flush_begin(traces)
+        t_run = time.perf_counter()
         try:
-            out = self.run_fn(np.stack([img for img, _, _, _ in batch]))
+            out = self.run_fn(np.stack([it[0] for it in batch]))
         except BaseException as e:  # noqa: BLE001 — route to the waiters
             self._m_failed.inc(len(batch))
-            for _, fut, _, _ in batch:
-                fut.set_exception(e)
+            err = f"{type(e).__name__}: {e}"
+            for it in batch:
+                if it[4] is not None:
+                    self._tracer.finish(it[4], "aborted", error=err)
+                it[1].set_exception(e)
             return
         done = time.perf_counter()
+        if traces:
+            # on the collector thread, right after run_fn: the engine's
+            # thread-local breakdown still belongs to this batch's predict
+            self._tracer.flush_end(traces, run_s=done - t_run, batch=len(batch))
         # one lock hand-off for the whole batch's latencies, before the
         # waiters wake (their submit→result time must not include it)
-        self._m_latency.observe_many([done - t for _, _, t, _ in batch])
+        self._m_latency.observe_many([done - it[2] for it in batch])
+        for tr in traces:
+            self._tracer.finish(tr, "ok")
         if isinstance(out, dict):
-            for i, (_, fut, _, _) in enumerate(batch):
-                fut.set_result({k: v[i] for k, v in out.items()})
+            for i, it in enumerate(batch):
+                it[1].set_result({k: v[i] for k, v in out.items()})
         else:
-            for (_, fut, _, _), row in zip(batch, out):
-                fut.set_result(row)
+            for it, row in zip(batch, out):
+                it[1].set_result(row)

@@ -208,6 +208,11 @@ class InferenceEngine:
         self._exec: dict[tuple[str, int], Any] = {}
         self.compile_counts: dict[tuple[str, int], int] = {}
         self._lock = threading.Lock()
+        # per-thread breakdown of the most recent predict on that thread
+        # (compute/fetch split, bucket, pad rows) — read back by
+        # last_breakdown() for request tracing. Thread-local because
+        # predicts run concurrently; a shared dict would interleave.
+        self._tls = threading.local()
 
     # ---------------------------------------------------------------- tasks
 
@@ -351,6 +356,10 @@ class InferenceEngine:
         if ex is not None:
             self._m_hits.labels(key[0]).inc()
             return ex
+        # build the task OUTSIDE the compile lock: _task takes the same
+        # non-reentrant lock on first build, so calling it under _lock
+        # deadlocks when the compile is the first touch (warmup-first)
+        t = self._task(task)
         with self._lock:
             ex = self._exec.get(key)
             if ex is not None:
@@ -358,7 +367,6 @@ class InferenceEngine:
                 return ex
             self._m_misses.labels(key[0]).inc()
             t_compile = time.perf_counter()
-            t = self._task(task)
             size = self.image_size
             images = jax.ShapeDtypeStruct((bucket, size, size, 3), jnp.uint8)
             # donate the request buffer: its HBM is recycled for
@@ -418,11 +426,44 @@ class InferenceEngine:
             pad = np.zeros((bucket - n, *images.shape[1:]), images.dtype)
             images = np.concatenate([images, pad])
         t = self._task(task)
+        t_compute = time.perf_counter()
         out = self._executable(task, pool, bucket)(t["params"], images, *extra)
-        return jax.tree_util.tree_map(lambda a: np.asarray(a)[:n], out)
+        # block here so compute vs fetch split cleanly: dispatch+execution
+        # ends at block_until_ready; what follows is device→host copy
+        jax.block_until_ready(out)
+        t_fetch = time.perf_counter()
+        out = jax.tree_util.tree_map(lambda a: np.asarray(a)[:n], out)
+        bd = self._tls.bd
+        bd["compute_s"] += t_fetch - t_compute
+        bd["fetch_s"] += time.perf_counter() - t_fetch
+        bd["bucket"] = max(bd["bucket"], bucket)
+        bd["pad_rows"] += bucket - n
+        bd["bucket_rows"] += bucket
+        return out
+
+    def last_breakdown(self) -> dict | None:
+        """The compute/fetch/bucket/pad breakdown of the most recent predict
+        *on the calling thread* (``None`` before any). This is the
+        ``RequestTracer(breakdown=...)`` feed: the micro-batcher's collector
+        thread calls predict and reads this right after, so the value can't
+        be clobbered by a concurrent caller."""
+        bd = getattr(self._tls, "bd", None)
+        if bd is None:
+            return None
+        rows = bd["bucket_rows"]
+        return {
+            "compute_s": bd["compute_s"],
+            "fetch_s": bd["fetch_s"],
+            "bucket": bd["bucket"],
+            "pad_fraction": (bd["pad_rows"] / rows) if rows else 0.0,
+        }
 
     def _predict(self, task: str, images, *, pool=None, extra=()):
         t0 = time.perf_counter()
+        self._tls.bd = {
+            "compute_s": 0.0, "fetch_s": 0.0,
+            "bucket": 0, "pad_rows": 0, "bucket_rows": 0,
+        }
         images = np.asarray(images)
         if images.ndim == 3:
             images = images[None]

@@ -12,6 +12,12 @@
   inside the jitted train step (``run.diag_every``).
 - ``obs.journal``  — append-only crash-safe JSONL run journal + reader.
 - ``obs.flightrec`` — crash flight recorder (ring buffer + black-box dumps).
+- ``obs.reqtrace`` — per-request trace context for the serving path + the
+  crash-safe JSONL access log (``tools/serve_doctor.py`` reads it offline).
+- ``obs.slo``      — declarative SLO objectives, rolling-window burn rates,
+  and the latched degraded flag surfaced in ``/healthz``.
+- ``obs.doctor_common`` — markdown/window helpers shared by the offline
+  doctors (``tools/run_doctor.py``, ``tools/serve_doctor.py``).
 
 The former ``utils/meters.py`` / ``utils/mfu.py`` / ``utils/profiling.py``
 modules remain as import-compatible shims over this package.
@@ -57,6 +63,13 @@ from jumbo_mae_tpu_tpu.obs.mfu import (
     mfu_report,
     pretrain_flops_per_image,
 )
+from jumbo_mae_tpu_tpu.obs.reqtrace import (
+    OUTCOMES,
+    AccessLog,
+    RequestTrace,
+    RequestTracer,
+)
+from jumbo_mae_tpu_tpu.obs.slo import SLOObjective, SLOTracker, parse_slo
 from jumbo_mae_tpu_tpu.obs.trace import (
     annotate,
     export_chrome_trace,
@@ -68,6 +81,7 @@ from jumbo_mae_tpu_tpu.obs.trace import (
 )
 
 __all__ = [
+    "AccessLog",
     "AverageMeter",
     "Counter",
     "Family",
@@ -80,9 +94,14 @@ __all__ = [
     "MfuReport",
     "NULL_REGISTRY",
     "NullRegistry",
+    "OUTCOMES",
     "PEAK_TFLOPS",
     "RATIO_BUCKETS",
+    "RequestTrace",
+    "RequestTracer",
     "RunJournal",
+    "SLOObjective",
+    "SLOTracker",
     "STAT_NAMES",
     "TelemetryServer",
     "annotate",
@@ -98,6 +117,7 @@ __all__ = [
     "group_stats",
     "journal_dir",
     "mfu_report",
+    "parse_slo",
     "pretrain_flops_per_image",
     "publish_group_stats",
     "read_journal",

@@ -51,6 +51,7 @@ class HealthState:
         self._max_age: dict[str, float] = {}
         self._beats: dict[str, float] = {}
         self._probes: dict[str, object] = {}
+        self._degraded_fn = None
 
     def set_ready(self, ready: bool = True, detail: str = "") -> None:
         with self._lock:
@@ -76,12 +77,23 @@ class HealthState:
         with self._lock:
             self._probes[name] = fn
 
+    def degraded_when(self, fn) -> None:
+        """Attach a zero-arg predicate (e.g. ``SLOTracker.degraded``) whose
+        truthiness is surfaced as ``body["degraded"]``. Degraded is *soft*:
+        the process is serving but missing its SLO — it must NOT flip the
+        503 readiness/liveness verdict, or an autoscaler reacting to load
+        would see its overloaded replicas drop out of rotation and make the
+        overload worse."""
+        with self._lock:
+            self._degraded_fn = fn
+
     def report(self) -> tuple[bool, dict]:
         now = time.monotonic()
         with self._lock:
             ready, detail = self._ready, self._detail
             watches = dict(self._max_age)
             probes = dict(self._probes)
+            degraded_fn = self._degraded_fn
         checks = {}
         ok = ready
         for name, budget in sorted(watches.items()):
@@ -95,6 +107,11 @@ class HealthState:
                 "ok": alive,
             }
         body = {"ok": ok, "ready": ready, "checks": checks}
+        if degraded_fn is not None:
+            try:
+                body["degraded"] = bool(degraded_fn())
+            except Exception as e:  # noqa: BLE001 — never break /healthz
+                body["degraded"] = f"probe error: {type(e).__name__}: {e}"
         if probes:
             info = {}
             for name, fn in sorted(probes.items()):
@@ -164,6 +181,21 @@ class TelemetryServer:
         self.port = int(port)
         self._httpd: _Server | None = None
         self._thread: threading.Thread | None = None
+        self._pre_scrape: list = []
+
+    def add_pre_scrape(self, fn) -> None:
+        """Register a zero-arg callable run before every ``/metrics`` render
+        (scrape-time gauge refresh — uptime, SLO evaluation). Safe to call
+        before or after ``start()``; a hook that raises is swallowed so one
+        broken refresher cannot take down the scrape."""
+        self._pre_scrape.append(fn)
+
+    def _run_pre_scrape(self) -> None:
+        for fn in list(self._pre_scrape):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — scrape must survive hooks
+                pass
 
     def start(self) -> "TelemetryServer":
         if self._httpd is not None:
@@ -192,9 +224,10 @@ class TelemetryServer:
             "process_uptime_seconds",
             "seconds since process start — a near-zero value means restart",
         )
-        httpd.pre_scrape = lambda: g_uptime.set(
-            time.monotonic() - _PROCESS_START
+        self.add_pre_scrape(
+            lambda: g_uptime.set(time.monotonic() - _PROCESS_START)
         )
+        httpd.pre_scrape = self._run_pre_scrape
         self.port = httpd.server_address[1]
         self._httpd = httpd
         self._thread = threading.Thread(

@@ -350,3 +350,31 @@ def test_microbatcher_serves_engine_concurrently(engine):
         rows = np.stack([f.result(timeout=30) for f in futs])
     np.testing.assert_array_equal(rows, direct)
     assert max(mb.batch_sizes) <= 8
+
+
+def test_last_breakdown_thread_local(engine):
+    """The compute/fetch/bucket/pad breakdown reflects the calling thread's
+    most recent predict — the RequestTracer(breakdown=...) contract."""
+    engine.features(_images(5, seed=9))  # bucket 8, 3 pad rows
+    bd = engine.last_breakdown()
+    assert bd["bucket"] == 8
+    assert bd["pad_fraction"] == pytest.approx(3 / 8)
+    assert bd["compute_s"] > 0.0
+    assert bd["fetch_s"] >= 0.0
+    # a thread that never predicted sees None, not another thread's batch
+    seen = {}
+    t = threading.Thread(
+        target=lambda: seen.update(bd=engine.last_breakdown())
+    )
+    t.start()
+    t.join()
+    assert seen["bd"] is None
+
+
+def test_warmup_first_does_not_deadlock():
+    """warmup() as the very first engine touch must build the task outside
+    the compile lock (regression: _executable used to re-enter _lock via
+    _task and deadlock when nothing had predicted yet)."""
+    eng = InferenceEngine(tiny_cfg(), max_batch=2)
+    assert eng.warmup(("features",), buckets=(1, 2)) == 2
+    assert eng.warmup(("features",), buckets=(1, 2)) == 0  # cached now

@@ -1,0 +1,601 @@
+"""Request tracing, SLO tracking, and serve_doctor contracts.
+
+The serving-observability invariants this PR stands on:
+
+- every request that enters the micro-batcher finishes with exactly one
+  terminal outcome, and (with an access log attached) exactly one access-
+  log row — including under a concurrent submit/close storm with mixed
+  deadlines (the one-to-one contract serve_doctor's offline analysis
+  assumes);
+- the SLO tracker's multi-window burn rates, the latched degraded flag,
+  and the ``slo_*`` gauges behave deterministically under a fake clock;
+- ``/healthz`` carries the degraded flag and live serving stats without
+  flipping readiness, and ``/metrics`` runs every registered pre-scrape
+  hook;
+- ``serve_doctor`` names the violating request window and the dominant
+  latency component from the access log alone.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.infer.batching import (
+    DeadlineExceededError,
+    MicroBatcher,
+    QueueFullError,
+    ShutdownError,
+)
+from jumbo_mae_tpu_tpu.obs import (
+    AccessLog,
+    HealthState,
+    RequestTracer,
+    SLOTracker,
+    TelemetryServer,
+    parse_slo,
+)
+from jumbo_mae_tpu_tpu.obs.doctor_common import contiguous_windows, spans_text
+from jumbo_mae_tpu_tpu.obs.journal import read_journal
+from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+
+# ------------------------------------------------------------- SLO parsing
+
+
+def test_parse_slo_grammar():
+    objs = parse_slo("p99_latency_ms<=250; success_rate>=0.99")
+    assert [o.name for o in objs] == [
+        "p99_latency_ms<=250",
+        "success_rate>=0.99",
+    ]
+    assert objs[0].percentile == 99.0
+    assert objs[0].budget == pytest.approx(0.01)
+    assert objs[1].percentile is None
+    assert objs[1].budget == pytest.approx(0.01)
+    assert parse_slo("p50_latency_ms<=10")[0].budget == pytest.approx(0.5)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        "p99_latency_ms>=250",     # latency wants <=
+        "success_rate<=0.99",      # success wants >=
+        "success_rate>=2",         # out of (0,1)
+        "error_rate<=0.1",         # unknown metric
+        "p99_latency_ms=250",      # bad operator
+    ],
+)
+def test_parse_slo_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_slo(bad)
+
+
+# --------------------------------------------------- tracer + access log
+
+
+def test_tracer_lifecycle_and_access_log(tmp_path):
+    reg = MetricsRegistry()
+    finished = []
+    with AccessLog(tmp_path / "access") as log:
+        tracer = RequestTracer(
+            registry=reg, access_log=log, on_finish=finished.append
+        )
+        traces = [tracer.begin(task="features") for _ in range(3)]
+        assert [t.rid for t in traces] == [0, 1, 2]  # monotonic rids
+        for t in traces:
+            tracer.admitted(t)
+        tracer.flush_begin(traces)
+        tracer.flush_end(traces, run_s=0.05, batch=3)
+        for t in traces:
+            tracer.finish(t, "ok")
+        shed = tracer.begin()
+        tracer.finish(shed, "shed")
+
+    rows = [
+        e for e in read_journal(tmp_path / "access") if e["type"] == "request"
+    ]
+    assert [r["rid"] for r in rows] == [0, 1, 2, 3]
+    assert [r["outcome"] for r in rows] == ["ok", "ok", "ok", "shed"]
+    ok = rows[0]
+    # the full breakdown survives the round-trip
+    assert ok["batch"] == 3
+    assert ok["compute_ms"] == pytest.approx(50.0)  # run_s with no engine
+    assert ok["lat_ms"] >= ok["queue_wait_ms"]
+    # a never-admitted request's wait is its whole latency
+    assert rows[3]["queue_wait_ms"] == rows[3]["lat_ms"]
+    assert len(finished) == 4
+    assert reg.counter(
+        "request_outcomes_total", "x", labels=("outcome",)
+    ).labels("ok").value == 3
+
+
+def _traced_batcher(tmp_path, run_fn, **kw):
+    reg = MetricsRegistry()
+    log = AccessLog(tmp_path / "access")
+    tracer = RequestTracer(registry=reg, access_log=log)
+    mb = MicroBatcher(run_fn, registry=reg, tracer=tracer, **kw)
+    return mb, log
+
+
+def _rows(log):
+    log.close()
+    return [e for e in read_journal(log.path) if e["type"] == "request"]
+
+
+def test_batcher_outcomes_ok_and_rid(tmp_path):
+    mb, log = _traced_batcher(tmp_path, lambda b: b * 2.0, max_batch=4)
+    with mb:
+        futs = [mb.submit(np.full((2,), i, np.float32)) for i in range(6)]
+        results = [f.result() for f in futs]
+    rows = _rows(log)
+    assert sorted(r["rid"] for r in rows) == sorted(f.rid for f in futs)
+    assert all(r["outcome"] == "ok" for r in rows)
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(r, np.full((2,), 2.0 * i))
+
+
+def test_batcher_outcome_shed(tmp_path):
+    release = threading.Event()
+
+    def slow(batch):
+        release.wait(5.0)
+        return batch
+
+    mb, log = _traced_batcher(
+        tmp_path, slow, max_batch=1, max_delay_ms=1.0, max_queue=1
+    )
+    with mb:
+        first = mb.submit(np.zeros(1))
+        # wait for the collector to pop request 0 into a (blocked) flush,
+        # then saturate the queue bound
+        deadline = time.monotonic() + 5.0
+        while not mb.batch_sizes and time.monotonic() < deadline:
+            time.sleep(0.001)
+        second = mb.submit(np.zeros(1))  # occupies the single queue slot
+        with pytest.raises(QueueFullError):
+            mb.submit(np.zeros(1))
+        release.set()
+        first.result(5.0)
+        second.result(5.0)
+    rows = {r["rid"]: r for r in _rows(log)}
+    assert len(rows) == 3
+    outcomes = sorted(r["outcome"] for r in rows.values())
+    assert outcomes == ["ok", "ok", "shed"]
+    shed_rid = next(r for r in rows.values() if r["outcome"] == "shed")["rid"]
+    assert shed_rid not in (first.rid, second.rid)
+
+
+def test_batcher_outcome_deadline(tmp_path):
+    release = threading.Event()
+
+    def slow(batch):
+        release.wait(5.0)
+        return batch
+
+    mb, log = _traced_batcher(tmp_path, slow, max_batch=1, max_delay_ms=1.0)
+    with mb:
+        first = mb.submit(np.zeros(1))
+        expiring = mb.submit(np.zeros(1), deadline_ms=5.0)
+        time.sleep(0.05)  # let the deadline lapse while queued behind first
+        release.set()
+        first.result(5.0)
+        with pytest.raises(DeadlineExceededError):
+            expiring.result(5.0)
+    rows = {r["rid"]: r for r in _rows(log)}
+    assert rows[first.rid]["outcome"] == "ok"
+    assert rows[expiring.rid]["outcome"] == "deadline"
+    assert rows[expiring.rid]["deadline_ms"] == 5.0
+
+
+def test_batcher_outcome_aborted_on_run_fn_error(tmp_path):
+    def boom(batch):
+        raise RuntimeError("kaput")
+
+    mb, log = _traced_batcher(tmp_path, boom, max_batch=4, max_delay_ms=1.0)
+    with mb:
+        fut = mb.submit(np.zeros(1))
+        with pytest.raises(RuntimeError, match="kaput"):
+            fut.result(5.0)
+    rows = _rows(log)
+    assert rows[0]["outcome"] == "aborted"
+    assert "kaput" in rows[0]["err"]
+
+
+def test_batcher_outcome_shutdown(tmp_path):
+    release = threading.Event()
+
+    def slow(batch):
+        release.wait(5.0)
+        return batch
+
+    mb, log = _traced_batcher(tmp_path, slow, max_batch=1, max_delay_ms=1.0)
+    first = mb.submit(np.zeros(1))
+    # make sure first is in a (blocked) flush before closing, so it is the
+    # one that completes and queued is the one close() sheds
+    deadline = time.monotonic() + 5.0
+    while not mb.batch_sizes and time.monotonic() < deadline:
+        time.sleep(0.001)
+    queued = mb.submit(np.zeros(1))
+    release.set()
+    mb.close()  # drain=True: the queued request is shed with ShutdownError
+    first.result(5.0)
+    with pytest.raises(ShutdownError):
+        queued.result(5.0)
+    with pytest.raises(RuntimeError):
+        mb.submit(np.zeros(1))  # post-close submit traces as shutdown too
+    rows = {r["rid"]: r for r in _rows(log)}
+    assert rows[queued.rid]["outcome"] == "shutdown"
+    assert sorted(r["outcome"] for r in rows.values()) == [
+        "ok", "shutdown", "shutdown",
+    ]
+
+
+def test_batcher_stats_snapshot(tmp_path):
+    release = threading.Event()
+
+    def slow(batch):
+        release.wait(5.0)
+        return batch
+
+    mb, log = _traced_batcher(
+        tmp_path, slow, max_batch=2, max_delay_ms=1.0, max_queue=1
+    )
+    with mb:
+        first = mb.submit(np.zeros(1))
+        deadline = time.monotonic() + 5.0
+        while not mb.batch_sizes and time.monotonic() < deadline:
+            time.sleep(0.001)
+        mb.submit(np.zeros(1))
+        with pytest.raises(QueueFullError):
+            mb.submit(np.zeros(1))
+        s = mb.stats()
+        assert s["queue_depth"] == 1
+        assert s["requests_submitted"] == 3
+        assert s["requests_shed"] == 1
+        assert s["shed_rate"] == pytest.approx(1 / 3, abs=1e-4)
+        release.set()
+    s = mb.stats()
+    assert s["queue_depth"] == 0
+    assert set(s) == {
+        "queue_depth", "batch_occupancy", "mean_batch_occupancy",
+        "requests_submitted", "requests_shed", "shed_rate",
+    }
+    log.close()
+
+
+# ------------------------------------------- satellite: concurrent stress
+
+
+def test_batcher_stress_every_future_exactly_one_outcome(tmp_path):
+    """Concurrent submit/close with mixed deadlines: every future resolves
+    with exactly one outcome and access-log rows match begun requests
+    one-to-one (the crash-safe audit trail is complete)."""
+    def run(batch):
+        time.sleep(0.002)
+        return batch
+
+    mb, log = _traced_batcher(
+        tmp_path, run, max_batch=8, max_delay_ms=1.0, max_queue=64
+    )
+    futures: list[Future] = []
+    submit_errors: list[BaseException] = []
+    lock = threading.Lock()
+    n_threads, per_thread = 8, 40
+
+    def client(tid):
+        rs = np.random.RandomState(tid)
+        for i in range(per_thread):
+            dl = None if i % 3 else float(rs.uniform(0.1, 3.0))
+            try:
+                f = mb.submit(np.full((2,), tid, np.float32), deadline_ms=dl)
+            except (QueueFullError, RuntimeError) as e:
+                with lock:
+                    submit_errors.append(e)
+            else:
+                with lock:
+                    futures.append(f)
+            if i % 10 == 9:
+                time.sleep(0.001)
+
+    threads = [
+        threading.Thread(target=client, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    mb.close()  # races the submitting threads on purpose
+    for t in threads:
+        t.join()
+
+    # every handed-out future resolved — exactly one outcome each
+    outcomes = {"ok": 0, "deadline": 0, "shutdown": 0}
+    for f in futures:
+        assert f.done(), "close() left a future unresolved"
+        exc = f.exception(timeout=0)
+        if exc is None:
+            outcomes["ok"] += 1
+        elif isinstance(exc, DeadlineExceededError):
+            outcomes["deadline"] += 1
+        elif isinstance(exc, ShutdownError):
+            outcomes["shutdown"] += 1
+        else:  # pragma: no cover - any other exception is a bug
+            raise AssertionError(f"unexpected outcome {exc!r}")
+
+    rows = _rows(log)
+    # one row per begun request: submitted futures + raising submits
+    assert len(rows) == len(futures) + len(submit_errors)
+    rids = [r["rid"] for r in rows]
+    assert len(set(rids)) == len(rids)  # rids unique
+    by_rid = {r["rid"]: r for r in rows}
+    # resolved futures and rows agree outcome-for-outcome via fut.rid
+    for f in futures:
+        row = by_rid[f.rid]
+        exc = f.exception(timeout=0)
+        expect = (
+            "ok" if exc is None
+            else "deadline" if isinstance(exc, DeadlineExceededError)
+            else "shutdown"
+        )
+        assert row["outcome"] == expect
+    row_counts = {}
+    for r in rows:
+        row_counts[r["outcome"]] = row_counts.get(r["outcome"], 0) + 1
+    for k, v in outcomes.items():
+        assert row_counts.get(k, 0) >= v if k == "shutdown" else True
+    assert row_counts.get("ok", 0) == outcomes["ok"]
+
+
+# ------------------------------------------------------------ SLO tracker
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_tracker_burn_and_latch():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    depth = {"v": 7}
+    tkr = SLOTracker(
+        parse_slo("p99_latency_ms<=100;success_rate>=0.9"),
+        window_s=60.0,
+        fast_window_s=5.0,
+        registry=reg,
+        probes={"queue_depth": lambda: depth["v"]},
+        clock=clock,
+    )
+    for _ in range(99):
+        tkr.observe(0.01, "ok")
+    rep = tkr.evaluate()
+    assert not rep["degraded"]
+    assert all(not o["breached"] for o in rep["objectives"])
+
+    # 10 slow requests out of ~109 → ~9% violations vs a 1% budget
+    for _ in range(10):
+        tkr.observe(0.5, "ok")
+    rep = tkr.evaluate()
+    lat = rep["objectives"][0]
+    assert lat["breached"] and rep["degraded"]
+    assert lat["burn_slow"] == pytest.approx(10 / 109 / 0.01, rel=1e-3)
+    assert rep["objectives"][1]["breached"] is False  # all ok so far
+    g = reg.gauge("slo_degraded", "x")
+    assert g.value == 1.0
+    assert reg.gauge("slo_queue_depth", "x").value == 7.0
+    assert (
+        reg.gauge("slo_breached", "x", labels=("objective",))
+        .labels("p99_latency_ms<=100").value == 1.0
+    )
+
+    # the degraded flag stays latched for window_s after the samples age out
+    clock.t += 61.0
+    rep = tkr.evaluate()
+    assert rep["samples"] == 0
+    assert not any(o["breached"] for o in rep["objectives"])
+    assert tkr._degraded_at(clock()) is False  # 61s > window since breach
+    assert rep["degraded"] is False
+    assert reg.gauge("slo_degraded", "x").value == 0.0
+
+
+def test_slo_tracker_degraded_latch_holds_within_window():
+    clock = FakeClock()
+    tkr = SLOTracker(
+        parse_slo("success_rate>=0.9"),
+        window_s=60.0,
+        fast_window_s=5.0,
+        registry=MetricsRegistry(),
+        clock=clock,
+    )
+    for _ in range(5):
+        tkr.observe(None, "shed")
+    assert tkr.evaluate()["degraded"]
+    # 30s later the incident is over (95 ok dilute the sheds below the 10%
+    # budget) — no current breach, but the latch holds for window_s
+    clock.t += 30.0
+    for _ in range(95):
+        tkr.observe(0.01, "ok")
+    rep = tkr.evaluate()
+    assert not any(o["breached"] for o in rep["objectives"])
+    assert rep["degraded"] is True
+    assert tkr.degraded() is True
+    clock.t += 31.0  # 61s past the breach; the latch releases
+    assert tkr.degraded() is False
+
+
+def test_slo_tracker_empty_fast_window_confirms_breach():
+    """A stalled request stream (empty fast window) must not mask a slow-
+    window breach."""
+    clock = FakeClock()
+    tkr = SLOTracker(
+        parse_slo("success_rate>=0.9"),
+        window_s=60.0,
+        fast_window_s=5.0,
+        registry=MetricsRegistry(),
+        clock=clock,
+    )
+    for _ in range(10):
+        tkr.observe(None, "aborted")
+    clock.t += 10.0  # breaches are now outside the fast window
+    rep = tkr.evaluate()
+    assert rep["objectives"][0]["burn_fast"] == 0.0
+    assert rep["objectives"][0]["breached"] is True
+
+
+def test_slo_shed_rate_gauge():
+    reg = MetricsRegistry()
+    tkr = SLOTracker(
+        parse_slo("success_rate>=0.5"), window_s=60.0, registry=reg
+    )
+    tkr.observe(0.01, "ok")
+    tkr.observe(None, "shed")
+    rep = tkr.evaluate()
+    assert rep["shed_rate"] == pytest.approx(0.5)
+    assert reg.gauge("slo_shed_rate", "x").value == pytest.approx(0.5)
+
+
+def test_slo_add_probe_publishes_gauge():
+    reg = MetricsRegistry()
+    tkr = SLOTracker(
+        parse_slo("success_rate>=0.5"), window_s=60.0, registry=reg
+    )
+    depth = {"v": 7}
+    tkr.add_probe("queue_depth", lambda: depth["v"])
+    tkr.add_probe("broken", lambda: 1 / 0)  # must not break evaluation
+    tkr.evaluate()
+    assert reg.gauge("slo_queue_depth", "x").value == 7.0
+    depth["v"] = 3
+    tkr.evaluate()
+    assert reg.gauge("slo_queue_depth", "x").value == 3.0
+
+
+# --------------------------------------------------- exporter integration
+
+
+def test_healthstate_degraded_when_does_not_flip_ok():
+    h = HealthState(ready=True)
+    flag = {"v": False}
+    h.degraded_when(lambda: flag["v"])
+    ok, body = h.report()
+    assert ok and body["degraded"] is False
+    flag["v"] = True
+    ok, body = h.report()
+    assert ok, "degraded must not flip the 503 readiness verdict"
+    assert body["degraded"] is True
+    h.degraded_when(lambda: 1 / 0)
+    ok, body = h.report()
+    assert ok and "probe error" in body["degraded"]
+
+
+def test_exporter_pre_scrape_hooks_and_serving_probe():
+    reg = MetricsRegistry()
+    health = HealthState(ready=True)
+    calls = {"n": 0}
+
+    def bump():
+        calls["n"] += 1
+        reg.gauge("test_prescrape_runs", "x").set(calls["n"])
+
+    mb = MicroBatcher(lambda b: b, registry=reg, max_batch=2)
+    health.probe("serving", mb.stats)
+    srv = TelemetryServer(registry=reg, health=health, host="127.0.0.1", port=0)
+    srv.add_pre_scrape(bump)
+    srv.add_pre_scrape(lambda: 1 / 0)  # a broken hook must not break scrapes
+    with srv:
+        mb.submit(np.zeros(1)).result(5.0)
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "test_prescrape_runs 1" in text
+        assert "process_uptime_seconds" in text
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["ok"]
+        serving = body["info"]["serving"]
+        assert serving["requests_submitted"] == 1
+        assert serving["queue_depth"] == 0
+    mb.close()
+    assert calls["n"] == 1
+
+
+# ------------------------------------------------------------ serve_doctor
+
+
+def _write_access_log(tmp_path):
+    """Synthetic access log: 20 fast ok, 10 slow queue-wait-dominated ok
+    (rids 20-29), 4 shed — a textbook queue-pressure incident."""
+    log = AccessLog(tmp_path / "access")
+    t0 = 1_700_000_000.0
+    for rid in range(20):
+        log.event(
+            "request", ts_override=None, rid=rid, outcome="ok", lat_ms=20.0,
+            queue_wait_ms=4.0, admission_ms=2.0, compute_ms=12.0,
+            fetch_ms=2.0, batch=8, bucket=8, pad=0.0,
+        )
+    for rid in range(20, 30):
+        log.event(
+            "request", rid=rid, outcome="ok", lat_ms=600.0,
+            queue_wait_ms=520.0, admission_ms=30.0, compute_ms=40.0,
+            fetch_ms=10.0, batch=2, bucket=2, pad=0.5,
+        )
+    for rid in range(30, 34):
+        log.event(
+            "request", rid=rid, outcome="shed", lat_ms=0.1,
+            queue_wait_ms=0.1,
+        )
+    log.close()
+    assert t0 > 0
+    return tmp_path / "access"
+
+
+def test_serve_doctor_names_window_and_component(tmp_path, capsys):
+    from tools.serve_doctor import main as doctor_main
+
+    path = _write_access_log(tmp_path)
+    out = tmp_path / "diagnosis.md"
+    rc = doctor_main(
+        [str(path), "--slo", "p99_latency_ms<=150;success_rate>=0.9",
+         "--out", str(out)]
+    )
+    assert rc == 0
+    report = out.read_text()
+    assert "breached" in report
+    assert "requests 20–29" in report       # the violating rid cluster
+    assert "queue_wait" in report           # dominant latency component
+    assert "← dominant" in report
+    assert "requests 30–33" in report       # the shed cluster
+    assert "worst bucket by p99: **2**" in report
+
+
+def test_serve_doctor_auto_threshold_without_slo(tmp_path):
+    from tools.serve_doctor import main as doctor_main
+
+    path = _write_access_log(tmp_path)
+    out = tmp_path / "d.md"
+    assert doctor_main([str(path), "--out", str(out)]) == 0
+    report = out.read_text()
+    assert "auto slow-request threshold" in report
+    assert "requests 20–29" in report
+
+
+def test_serve_doctor_exit_2_on_missing_or_empty(tmp_path):
+    from tools.serve_doctor import main as doctor_main
+
+    assert doctor_main([str(tmp_path / "nope")]) == 2
+    log = AccessLog(tmp_path / "empty")
+    log.event("slo_summary", report={})  # events, but no request rows
+    log.close()
+    assert doctor_main([str(tmp_path / "empty")]) == 2
+
+
+def test_doctor_common_windows():
+    assert contiguous_windows([7, 5, 6, 12, 5]) == [(5, 7), (12, 12)]
+    assert spans_text([(5, 7), (12, 12)]) == "steps 5–7, step 12"
+    assert spans_text([(3, 3)], noun="request") == "request 3"

@@ -100,10 +100,30 @@ def _percentiles(lat_s: list[float]) -> dict:
 
     ms = np.asarray(lat_s) * 1000.0
     return {
+        # exact quantiles over the raw per-request samples — NOT the
+        # LATENCY_BUCKETS-quantized Histogram.quantile readout, whose
+        # bucket-edge resolution is fine for dashboards but too coarse for
+        # a bench's A/B deltas
         "p50_ms": round(float(np.percentile(ms, 50)), 3),
         "p99_ms": round(float(np.percentile(ms, 99)), 3),
         "mean_ms": round(float(ms.mean()), 3),
+        "quantile_source": "exact_samples",
     }
+
+
+def _trace_summary(rows: list) -> dict:
+    """Per-leg trace summary: outcome counts + mean per-leg milliseconds
+    over the finished traces (queue wait / coalescing / compute / fetch)."""
+    out: dict = {"requests": len(rows), "outcomes": {}}
+    for tr in rows:
+        out["outcomes"][tr.outcome] = out["outcomes"].get(tr.outcome, 0) + 1
+    for name in ("queue_wait_s", "admission_s", "compute_s", "fetch_s"):
+        vals = [getattr(tr, name) for tr in rows if getattr(tr, name) is not None]
+        if vals:
+            out[f"mean_{name[:-2]}_ms"] = round(
+                sum(vals) / len(vals) * 1000.0, 3
+            )
+    return out
 
 
 def main(argv: list[str] | None = None) -> dict:
@@ -191,8 +211,24 @@ def main(argv: list[str] | None = None) -> dict:
     def run_batch(batch):
         return engine.predict(batch, task=args.task, **kw)
 
+    # with telemetry on, the engine leg runs fully traced (per-request
+    # contexts + engine breakdown) — the measured cost IS the tracing
+    # overhead the off leg A/Bs against
+    trace_rows: list = []
+    tracer = None
+    if args.telemetry == "on":
+        from jumbo_mae_tpu_tpu.obs import RequestTracer
+
+        tracer = RequestTracer(
+            breakdown=engine.last_breakdown, on_finish=trace_rows.append
+        )
+
     with MicroBatcher(
-        run_batch, max_batch=args.max_batch, max_delay_ms=args.max_delay_ms
+        run_batch,
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        tracer=tracer,
+        task=args.task,
     ) as mb:
         engine_wall = float("inf")
         for _ in range(max(1, args.rounds)):
@@ -227,6 +263,20 @@ def main(argv: list[str] | None = None) -> dict:
         "mean_batch": round(float(np.mean(sizes)), 2),
         "batches": len(sizes),
     }
+    if tracer is not None:
+        eng["trace"] = _trace_summary(trace_rows)
+        # the registry's bucket-edge readout, kept alongside the exact
+        # numbers and explicitly marked approximate
+        from jumbo_mae_tpu_tpu.obs import get_registry
+
+        hist = get_registry().histogram(
+            "infer_request_latency_seconds",
+            "request latency: submit() to resolved future",
+        )
+        for label, q in (("hist_p50_ms", 0.5), ("hist_p99_ms", 0.99)):
+            v = hist.quantile(q) * 1000.0
+            eng[label] = round(v, 3) if v != float("inf") else "inf"
+        eng["hist_quantile_source"] = "bucket_edges_approximate"
 
     report = {
         "bench": "infer",

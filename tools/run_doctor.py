@@ -31,21 +31,13 @@ from pathlib import Path
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from jumbo_mae_tpu_tpu.obs.doctor_common import (  # noqa: E402
+    contiguous_windows,
+    fmt_num as _fmt_num,
+    spans_text,
+    write_report,
+)
 from jumbo_mae_tpu_tpu.obs.journal import read_journal  # noqa: E402
-
-
-def _fmt_num(v, nd=4):
-    if isinstance(v, (int, float)):
-        try:
-            f = float(v)
-        except (TypeError, ValueError):
-            return str(v)
-        if f != f or f in (float("inf"), float("-inf")):
-            return str(f)
-        if isinstance(v, int) or f.is_integer():
-            return str(int(f))
-        return f"{f:.{nd}g}"
-    return str(v)
 
 
 def _is_bad_loss(v) -> bool:
@@ -71,13 +63,7 @@ def _bad_windows(events: list[dict]) -> list[tuple[int, int]]:
             m = e.get("metrics", {}) or {}
             if _is_bad_loss(m.get("train/loss")) and "step" in e:
                 bad.add(int(e["step"]))
-    windows: list[tuple[int, int]] = []
-    for s in sorted(bad):
-        if windows and s == windows[-1][1] + 1:
-            windows[-1] = (windows[-1][0], s)
-        else:
-            windows.append((s, s))
-    return windows
+    return contiguous_windows(bad)
 
 
 def _grad_norm_series(events: list[dict]) -> list[tuple[int, float]]:
@@ -166,10 +152,9 @@ def diagnose(events: list[dict], flight: dict | None = None) -> str:
     reason = shutdowns[-1].get("reason", "unknown") if shutdowns else "no shutdown event (crashed hard?)"
     verdict = []
     if windows:
-        spans = ", ".join(
-            f"steps {a}–{b}" if a != b else f"step {a}" for a, b in windows
+        verdict.append(
+            f"**non-finite step window: {spans_text(windows, noun='step')}**"
         )
-        verdict.append(f"**non-finite step window: {spans}**")
     if rollbacks:
         verdict.append(f"{len(rollbacks)} sentinel rollback(s)")
     if quarantines:
@@ -346,13 +331,7 @@ def main(argv: list[str] | None = None) -> int:
             )
 
     report = diagnose(events, flight)
-    if args.out:
-        Path(args.out).parent.mkdir(parents=True, exist_ok=True)
-        Path(args.out).write_text(report)
-        print(f"[run_doctor] diagnosis -> {args.out}")
-    else:
-        print(report)
-    return 0
+    return write_report(report, args.out, tool="run_doctor")
 
 
 if __name__ == "__main__":
