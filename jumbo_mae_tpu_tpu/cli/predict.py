@@ -122,6 +122,36 @@ def build_parser() -> argparse.ArgumentParser:
         help="serving compute dtype override (e.g. float32 for the exact path)",
     )
     p.add_argument(
+        "--quant",
+        choices=("int8",),
+        default=None,
+        help="weight-only post-training quantization: int8 kernels with "
+        "per-output-channel f32 scales, dequantized on use (embeddings, "
+        "norms, biases stay f32)",
+    )
+    p.add_argument(
+        "--warmcache",
+        default=None,
+        metavar="DIR",
+        help="persistent executable cache directory (default: the per-host "
+        "dir under ~/.cache/jumbo_mae_tpu/warmcache; restarted replicas "
+        "load instead of compiling)",
+    )
+    p.add_argument(
+        "--no-warmcache",
+        action="store_true",
+        help="disable the persistent executable cache for this run",
+    )
+    p.add_argument(
+        "--encoder-cache",
+        type=int,
+        default=0,
+        metavar="N",
+        help="reconstruct: LRU-cache up to N encoder outputs keyed by "
+        "(image bytes, seed) — repeated decode of the same image skips "
+        "the encoder (shared mask mode only)",
+    )
+    p.add_argument(
         "--metrics-port",
         type=int,
         default=None,
@@ -174,13 +204,29 @@ def main(argv: list[str] | None = None) -> Path | None:
         print(f"[predict] exporter on :{telemetry.port} (/metrics, /healthz)")
 
     engine = InferenceEngine(
-        cfg, ckpt=args.ckpt, dtype=args.dtype, max_batch=args.max_batch
+        cfg,
+        ckpt=args.ckpt,
+        dtype=args.dtype,
+        max_batch=args.max_batch,
+        quant=args.quant,
+        warm_cache=(
+            False if args.no_warmcache
+            else args.warmcache if args.warmcache is not None
+            else True
+        ),
+        encoder_cache=args.encoder_cache,
     )
     if args.ckpt == "":
         print("[predict] WARNING: no --ckpt — serving a random init")
+    if engine.warmcache is not None:
+        print(f"[predict] warmcache: {engine.warmcache.root}")
     if args.warmup:
         n_compiles = engine.warmup((args.task,), pool=args.pool)
-        print(f"[predict] warmup: {n_compiles} executable(s) compiled")
+        hits = sum(engine.warm_hits.values())
+        print(
+            f"[predict] warmup: {n_compiles} executable(s) compiled, "
+            f"{hits} loaded from warmcache"
+        )
     if health is not None:
         health.set_ready(
             True, detail=f"engine up (ckpt={'yes' if args.ckpt else 'random'})"

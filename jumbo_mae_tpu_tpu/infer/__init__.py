@@ -4,13 +4,28 @@ from jumbo_mae_tpu_tpu.infer.batching import (
     QueueFullError,
     ShutdownError,
 )
-from jumbo_mae_tpu_tpu.infer.engine import InferenceEngine, bucket_for
+from jumbo_mae_tpu_tpu.infer.engine import (
+    InferenceEngine,
+    OversizedBatchError,
+    bucket_for,
+)
+from jumbo_mae_tpu_tpu.infer.quant import (
+    QuantizedTensor,
+    parity_report,
+    quantize_params,
+)
+from jumbo_mae_tpu_tpu.infer.warmcache import WarmCache
 
 __all__ = [
     "DeadlineExceededError",
     "InferenceEngine",
     "MicroBatcher",
+    "OversizedBatchError",
+    "QuantizedTensor",
     "QueueFullError",
     "ShutdownError",
+    "WarmCache",
     "bucket_for",
+    "parity_report",
+    "quantize_params",
 ]

@@ -84,11 +84,12 @@ class MicroBatcher:
 
     def __init__(
         self,
-        run_fn: Callable[[np.ndarray], Any],
+        run_fn: Callable[..., Any],
         *,
         max_batch: int = 32,
         max_delay_ms: float = 5.0,
         max_queue: int | None = None,
+        pass_meta: bool = False,
         registry=None,
         tracer=None,
         task: str = "",
@@ -101,6 +102,12 @@ class MicroBatcher:
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1000.0
         self.max_queue = max_queue
+        # pass_meta: run_fn becomes run_fn(batch, metas) where metas[i] is
+        # request i's submit(meta=...) value — the per-request side channel
+        # a router needs to serve heterogeneous requests (e.g. per-request
+        # reconstruction seeds, or cached-feature reuse hints) from one
+        # coalesced batch without smuggling state through globals
+        self.pass_meta = bool(pass_meta)
         self.batch_sizes: list[int] = []
         self._tracer = tracer  # obs.reqtrace.RequestTracer | None
         self.task = task
@@ -156,7 +163,11 @@ class MicroBatcher:
     # ------------------------------------------------------------- client
 
     def submit(
-        self, image: np.ndarray, *, deadline_ms: float | None = None
+        self,
+        image: np.ndarray,
+        *,
+        deadline_ms: float | None = None,
+        meta=None,
     ) -> Future:
         """Enqueue one request (a single image, no batch dim); returns a
         future resolving to that request's row of the batched result.
@@ -165,8 +176,9 @@ class MicroBatcher:
         requests are already pending (shed, don't buffer). With
         ``deadline_ms``, a request still queued that long after submit is
         failed with :class:`DeadlineExceededError` instead of occupying a
-        slot in a batch. With a tracer attached the returned future carries
-        the request id as ``fut.rid``.
+        slot in a batch. ``meta`` rides along to ``run_fn`` when the
+        batcher was built with ``pass_meta=True``. With a tracer attached
+        the returned future carries the request id as ``fut.rid``.
         """
         # trace begins before the fault point: an injected submit stall is
         # queue wait the caller experienced, and must be visible as such
@@ -210,7 +222,9 @@ class MicroBatcher:
         # submit stays latency-metric-free (counted batch-at-a-time in
         # _flush): at CPU-smoke request rates even one observe per submit
         # is measurable; the depth lock above is one uncontended acquire
-        self._q.put((np.asarray(image), fut, time.perf_counter(), deadline, tr))
+        self._q.put(
+            (np.asarray(image), fut, time.perf_counter(), deadline, tr, meta)
+        )
         return fut
 
     def __call__(self, image: np.ndarray, *, deadline_ms: float | None = None):
@@ -349,7 +363,12 @@ class MicroBatcher:
             self._tracer.flush_begin(traces)
         t_run = time.perf_counter()
         try:
-            out = self.run_fn(np.stack([it[0] for it in batch]))
+            stacked = np.stack([it[0] for it in batch])
+            out = (
+                self.run_fn(stacked, [it[5] for it in batch])
+                if self.pass_meta
+                else self.run_fn(stacked)
+            )
         except BaseException as e:  # noqa: BLE001 — route to the waiters
             self._m_failed.inc(len(batch))
             err = f"{type(e).__name__}: {e}"

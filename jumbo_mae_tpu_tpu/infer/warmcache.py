@@ -1,0 +1,280 @@
+"""Persistent, crash-safe cache of AOT-compiled serving executables.
+
+A serving replica's startup cost is the per-(task, bucket) compile ladder —
+seconds on CPU smoke, minutes for a real encoder at a full bucket set. The
+engine already guarantees the *request path* never compiles; this module
+makes the *warmup* free after the first process on a host: compiled
+executables are serialized (``jax.experimental.serialize_executable``) to a
+versioned on-disk cache and restarted replicas load them instead of
+compiling.
+
+Design constraints, in order:
+
+- **A corrupt entry must never crash the process.** The seed's history
+  documents XLA:CPU aborting the whole process deserializing a truncated
+  cache entry (jax's internal compilation cache writes non-atomically; a
+  ``timeout -k``'d test run poisoned it permanently — see
+  ``utils/procenv.claim_compile_cache``). Here a sha256 digest over the
+  payload is verified *before* any bytes reach XLA, writes are atomic
+  (unique tmp + ``os.replace``), and any entry that fails the header,
+  digest, unpickle, or XLA load is moved to ``quarantine/`` — kept for a
+  postmortem, never retried.
+- **Keyed so reuse is provably safe.** The entry name carries the model
+  fingerprint (every architecture/config field the traced program depends
+  on, plus jax/jaxlib versions, backend, and the host CPU fingerprint —
+  XLA:CPU executables embed machine features), the task, the bucket, the
+  compute dtype, and the quant mode. Parameters are executable *arguments*,
+  not constants, so different checkpoints of the same architecture share
+  entries by construction — the engine keeps anything value-dependent
+  (BatchNorm stats included) out of closure constants.
+- **Concurrent processes race safely.** Writers use per-process unique tmp
+  names; ``os.replace`` is atomic, last-writer-wins, and readers see either
+  a complete old entry or a complete new one, never a partial write.
+
+``python -m jumbo_mae_tpu_tpu.infer.warmcache`` is the restart probe: it
+builds an engine against a cache dir, warms it, runs a hot-path batch, and
+prints one JSON line with compile/hit counts and timings — bench_infer's
+cold/warm A/B and CI's restart-reuses-warmcache assertion both drive it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import sys
+import uuid
+from pathlib import Path
+
+# format version is part of MAGIC: bump it and every older entry misses
+# cleanly (no attempt to parse an incompatible layout)
+MAGIC = b"JWC1"
+_DIGEST_LEN = 32  # sha256
+
+
+def fingerprint(spec: dict) -> str:
+    """Stable short hash of a JSON-able spec dict (the engine feeds every
+    compile-relevant config field through this)."""
+    blob = json.dumps(spec, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def entry_name(
+    fp: str, task_key: str, bucket: int, dtype: str, quant: str | None
+) -> str:
+    """Filesystem-safe cache entry name — the (fingerprint, task, bucket,
+    dtype, quant) key schema README documents."""
+    safe = lambda s: re.sub(r"[^A-Za-z0-9_.-]", "_", str(s))  # noqa: E731
+    return (
+        f"{safe(fp)}-{safe(task_key)}-b{int(bucket)}"
+        f"-{safe(dtype)}-{safe(quant or 'none')}.exe"
+    )
+
+
+class WarmCache:
+    """One directory of serialized executables, with quarantine semantics.
+
+    All failure paths degrade to a miss: the caller compiles as if the
+    cache were cold. ``stats()`` plus the ``infer_warmcache_*`` counters
+    expose what actually happened.
+    """
+
+    def __init__(self, root: str | os.PathLike, *, registry=None):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        if registry is None:
+            from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+            registry = get_registry()
+        self._m = registry.counter(
+            "infer_warmcache_events_total",
+            "warm-start executable cache events",
+            labels=("event",),
+        )
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.put_errors = 0
+        self.quarantined = 0
+
+    # ------------------------------------------------------------------ io
+
+    def get(self, name: str):
+        """Load one executable, or None (miss / quarantined corrupt entry)."""
+        path = self.root / name
+        try:
+            blob = path.read_bytes()
+        except OSError:
+            self.misses += 1
+            self._m.labels("miss").inc()
+            return None
+        try:
+            if len(blob) < len(MAGIC) + _DIGEST_LEN or blob[: len(MAGIC)] != MAGIC:
+                raise ValueError("bad magic/header")
+            digest = blob[len(MAGIC) : len(MAGIC) + _DIGEST_LEN]
+            payload = blob[len(MAGIC) + _DIGEST_LEN :]
+            if hashlib.sha256(payload).digest() != digest:
+                raise ValueError("payload digest mismatch (truncated write?)")
+            # the pickled in/out treedefs may reference QuantizedTensor;
+            # importing quant registers the pytree node before unpickling
+            from jumbo_mae_tpu_tpu.infer import quant as _quant  # noqa: F401
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            serialized, in_tree, out_tree = pickle.loads(payload)
+            ex = deserialize_and_load(serialized, in_tree, out_tree)
+        except Exception as e:  # noqa: BLE001 — any corruption is a miss
+            self._quarantine(path, e)
+            self.misses += 1
+            self._m.labels("miss").inc()
+            return None
+        self.hits += 1
+        self._m.labels("hit").inc()
+        return ex
+
+    def put(self, name: str, compiled) -> bool:
+        """Serialize + atomically publish one executable; best-effort (a
+        full disk or an unserializable program must not fail serving)."""
+        path = self.root / name
+        tmp = None
+        try:
+            from jax.experimental.serialize_executable import serialize
+
+            payload = pickle.dumps(serialize(compiled))
+            blob = MAGIC + hashlib.sha256(payload).digest() + payload
+            tmp = path.with_name(
+                f".{name}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
+            )
+            tmp.write_bytes(blob)
+            os.replace(tmp, path)
+        except Exception as e:  # noqa: BLE001
+            if tmp is not None:
+                Path(tmp).unlink(missing_ok=True)
+            self.put_errors += 1
+            self._m.labels("put_error").inc()
+            print(f"[warmcache] put({name}) failed: {e}", file=sys.stderr)
+            return False
+        self.puts += 1
+        self._m.labels("put").inc()
+        return True
+
+    def _quarantine(self, path: Path, err: Exception):
+        """Move a bad entry aside — kept for postmortem, never re-read."""
+        qdir = self.root / "quarantine"
+        dst = qdir / f"{path.name}.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        try:
+            qdir.mkdir(exist_ok=True)
+            os.replace(path, dst)
+        except OSError:
+            path.unlink(missing_ok=True)
+        self.quarantined += 1
+        self._m.labels("quarantined").inc()
+        print(
+            f"[warmcache] quarantined corrupt entry {path.name}: {err}",
+            file=sys.stderr,
+        )
+
+    def stats(self) -> dict:
+        return {
+            "root": str(self.root),
+            "entries": len(list(self.root.glob("*.exe"))),
+            "hits": self.hits,
+            "misses": self.misses,
+            "puts": self.puts,
+            "put_errors": self.put_errors,
+            "quarantined": self.quarantined,
+        }
+
+
+# ------------------------------------------------------------- restart probe
+
+
+def _probe_main(argv: list[str] | None = None) -> dict:
+    """Restart probe: engine up against ``--dir``, warm, serve one hot batch,
+    print a JSON line. Run twice against the same dir to measure cold vs
+    warm start; the second run must report ``"compiles": 0``."""
+    import argparse
+    import time
+
+    p = argparse.ArgumentParser(description=_probe_main.__doc__)
+    p.add_argument("--dir", required=True, help="warmcache directory")
+    p.add_argument("--recipe", default=None, help="YAML recipe (default: CPU smoke)")
+    p.add_argument(
+        "--task", choices=("features", "logits", "reconstruct"), default="features"
+    )
+    p.add_argument("--pool", choices=("cls", "gap", "tokens"), default="cls")
+    p.add_argument("--ckpt", default="")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--buckets", type=int, nargs="*", default=None)
+    p.add_argument("--quant", choices=("int8",), default=None)
+    p.add_argument("--dtype", default=None)
+    p.add_argument("--probe-images", type=int, default=3)
+    p.add_argument("--out", default="", help="also write the JSON here")
+    p.add_argument(
+        "--set", dest="overrides", metavar="KEY.PATH=VALUE",
+        nargs="*", action="extend", default=[],
+    )
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.infer import InferenceEngine
+
+    recipe = args.recipe
+    if recipe is None:
+        recipe = str(
+            Path(__file__).resolve().parents[2] / "recipes" / "smoke_cpu.yaml"
+        )
+    cfg = load_config(recipe, args.overrides)
+
+    t0 = time.perf_counter()
+    engine = InferenceEngine(
+        cfg,
+        ckpt=args.ckpt,
+        dtype=args.dtype,
+        max_batch=args.max_batch,
+        quant=args.quant,
+        warm_cache=args.dir,
+    )
+    init_s = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    compiles = engine.warmup(
+        (args.task,),
+        pool=args.pool,
+        buckets=tuple(args.buckets) if args.buckets else None,
+    )
+    warmup_s = time.perf_counter() - t1
+    after_warm = sum(engine.compile_counts.values())
+    images = (
+        np.random.RandomState(0)
+        .randint(0, 256, (args.probe_images, engine.image_size, engine.image_size, 3))
+        .astype(np.uint8)
+    )
+    kw = {"pool": args.pool} if args.task == "features" else {}
+    engine.predict(images, task=args.task, **kw)
+    report = {
+        "probe": "warmcache",
+        "dir": args.dir,
+        "task": args.task,
+        "quant": args.quant,
+        "init_s": round(init_s, 3),
+        "warmup_s": round(warmup_s, 3),
+        "compiles": compiles,
+        "warm_hits": sum(engine.warm_hits.values()),
+        "hot_path_compiles": sum(engine.compile_counts.values()) - after_warm,
+        "executables": len(engine._exec),
+        "warmcache": engine.warmcache.stats() if engine.warmcache else None,
+    }
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        Path(args.out).write_text(line + "\n")
+    return report
+
+
+if __name__ == "__main__":
+    _probe_main()

@@ -730,7 +730,23 @@ def _restore_params_only(mgr, step) -> dict | None:
     return None if out is None else out.get("params")
 
 
-def restore_inference_state(path) -> tuple[dict, dict | None]:
+def _device_put_incremental(tree):
+    """Per-leaf host→device transfer that releases each host buffer as its
+    device copy lands: the recursion REBINDS every dict slot in place, so
+    after a leaf is transferred nothing references the numpy array anymore
+    and it is freed before the next leaf stages. Peak restore memory is one
+    full tree plus one leaf — not the host tree and the device tree side by
+    side, which is what caps serving-replica density on small hosts."""
+    if isinstance(tree, dict):
+        for k in tree:
+            tree[k] = _device_put_incremental(tree[k])
+        return tree
+    if tree is None:
+        return None
+    return jax.device_put(tree)
+
+
+def restore_inference_state(path, *, to_device: bool = False) -> tuple[dict, dict | None]:
     """Restore ``(params, batch_stats)`` for serving — the checkpoint's
     optimizer-state bytes are never read or staged (same partial-restore
     machinery as :meth:`Checkpointer.restore_eval`, without needing a live
@@ -738,37 +754,50 @@ def restore_inference_state(path) -> tuple[dict, dict | None]:
     none (pretrain/finetune trees; linear-probe trees carry the probe
     head's BatchNorm statistics, which deterministic serving needs).
 
+    ``to_device=True`` transfers the restored leaves to the default device
+    incrementally (:func:`_device_put_incremental`), dropping host buffers
+    as device copies land — the inference engine passes this so restore
+    peaks at ~one params tree instead of two.
+
     ``path`` accepts every :func:`load_params_tree` carrier: a Checkpointer
     run directory (``best``/``last`` layout, local or ``gs://``), a direct
     manager dir, a ``.msgpack`` params file, or a stream URL — the stream
     forms carry params only."""
-    s = str(path)
-    if s.startswith(("pipe:", "http://", "https://")) or (
-        is_remote_path(s) and s.endswith(".msgpack")
-    ):
-        return import_params_msgpack(s), None
-    p = checkpoint_root(s)
-    if not p.is_dir():
-        return import_params_msgpack(s), None
-    for sub in ("best", "last", "."):
-        root = p if sub == "." else p / sub
-        if not root.is_dir():
-            continue
-        with ocp.CheckpointManager(
-            root,
-            item_handlers={
-                "state": ocp.PyTreeCheckpointHandler(),
-                "extra": ocp.JsonCheckpointHandler(),
-            },
-        ) as mgr:
-            step = mgr.latest_step()
-            if step is None:
+
+    def _restore() -> tuple[dict, dict | None]:
+        s = str(path)
+        if s.startswith(("pipe:", "http://", "https://")) or (
+            is_remote_path(s) and s.endswith(".msgpack")
+        ):
+            return import_params_msgpack(s), None
+        p = checkpoint_root(s)
+        if not p.is_dir():
+            return import_params_msgpack(s), None
+        for sub in ("best", "last", "."):
+            root = p if sub == "." else p / sub
+            if not root.is_dir():
                 continue
-            out = _restore_subtrees(mgr, step, ("params", "batch_stats"))
-            if out is not None and out.get("params") is not None:
-                return out["params"], out.get("batch_stats")
-    # legacy layouts without usable metadata: whole-tree restore
-    return restore_params_any(p), None
+            with ocp.CheckpointManager(
+                root,
+                item_handlers={
+                    "state": ocp.PyTreeCheckpointHandler(),
+                    "extra": ocp.JsonCheckpointHandler(),
+                },
+            ) as mgr:
+                step = mgr.latest_step()
+                if step is None:
+                    continue
+                out = _restore_subtrees(mgr, step, ("params", "batch_stats"))
+                if out is not None and out.get("params") is not None:
+                    return out["params"], out.get("batch_stats")
+        # legacy layouts without usable metadata: whole-tree restore
+        return restore_params_any(p), None
+
+    params, batch_stats = _restore()
+    if to_device:
+        params = _device_put_incremental(params)
+        batch_stats = _device_put_incremental(batch_stats)
+    return params, batch_stats
 
 
 def restore_params_any(directory) -> dict:

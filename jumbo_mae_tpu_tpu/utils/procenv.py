@@ -60,6 +60,36 @@ def host_cache_dir(repo_root: str | os.PathLike) -> str:
     )
 
 
+def default_warmcache_dir() -> str | None:
+    """Default root for the serving warm-start executable cache
+    (``infer/warmcache.py``) — the engine's ``warm_cache=True`` resolves
+    through here. Resolution order:
+
+    - ``JUMBO_WARMCACHE=0`` disables the default entirely (the test suite
+      sets this: compile-count assertions need every compile to actually
+      happen). An *explicit* ``warm_cache=<path>`` on the engine ignores
+      this kill switch.
+    - ``JUMBO_WARMCACHE_DIR`` overrides the location (CI points it at a
+      scratch dir shared between the cold and warm probe processes).
+    - otherwise ``~/.cache/jumbo_mae_tpu/warmcache/host-<fingerprint>`` —
+      host-keyed for the same reason as :func:`host_cache_dir`: XLA:CPU
+      executables embed the compiling machine's CPU features, so entries
+      must never migrate between machines.
+    """
+    if os.environ.get("JUMBO_WARMCACHE", "1") == "0":
+        return None
+    override = os.environ.get("JUMBO_WARMCACHE_DIR")
+    if override:
+        return override
+    return os.path.join(
+        os.path.expanduser("~"),
+        ".cache",
+        "jumbo_mae_tpu",
+        "warmcache",
+        f"host-{host_fingerprint()}",
+    )
+
+
 def _pid_alive(pid: int) -> bool:
     try:
         os.kill(pid, 0)

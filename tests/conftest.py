@@ -49,6 +49,14 @@ if os.environ.get("JUMBO_COMPILE_CACHE"):
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
+# The serving warm-start cache (infer/warmcache.py) is default-ON for real
+# processes but must be inert under test: engines constructed by unrelated
+# tests would otherwise share executables through ~/.cache and the
+# compile-count contracts (compiles-exactly-once, warmup totals) would
+# depend on which test ran first. Tests that exercise the cache pass an
+# explicit warm_cache=<tmp dir>, which overrides this.
+os.environ.setdefault("JUMBO_WARMCACHE", "0")
+
 import pytest  # noqa: E402
 
 

@@ -19,7 +19,12 @@ import numpy as np
 import pytest
 
 from jumbo_mae_tpu_tpu.config import load_config
-from jumbo_mae_tpu_tpu.infer import InferenceEngine, MicroBatcher, bucket_for
+from jumbo_mae_tpu_tpu.infer import (
+    InferenceEngine,
+    MicroBatcher,
+    OversizedBatchError,
+    bucket_for,
+)
 
 RECIPE_OVERRIDES = [
     # tiny f32 config — the exact path the bit-identity contract runs on
@@ -53,11 +58,24 @@ def _images(n, size=32, seed=0):
 
 
 def test_bucket_for():
-    assert [bucket_for(n, 8) for n in (1, 2, 3, 4, 5, 7, 8, 9, 100)] == [
-        1, 2, 4, 4, 8, 8, 8, 8, 8,
+    assert [bucket_for(n, 8) for n in (1, 2, 3, 4, 5, 7, 8)] == [
+        1, 2, 4, 4, 8, 8, 8,
     ]
     with pytest.raises(ValueError):
         bucket_for(0, 8)
+    # regression: n > max_batch used to silently return max_batch, so an
+    # admitted 9-row batch was served by the bucket-8 executable and rows
+    # 8+ were silently DROPPED by the dispatch slice — now a typed error
+    # the caller can map to HTTP 413 (predict() still chunks upstream)
+    with pytest.raises(OversizedBatchError):
+        bucket_for(9, 8)
+    with pytest.raises(OversizedBatchError):
+        bucket_for(100, 8)
+    # non-power-of-two max_batch is the ladder's last rung, not rounded up
+    # past the admission limit
+    assert bucket_for(5, 6) == 6
+    assert bucket_for(33, 48) == 48
+    assert bucket_for(4, 6) == 4
 
 
 def test_padded_bucket_bit_identical(engine):
@@ -77,6 +95,7 @@ def test_padded_bucket_bit_identical(engine):
 
     t = engine._task("features")
     model = t["model"]
+    params = t["variables"]["params"]
     enc = engine._enc
 
     @jax.jit
@@ -87,13 +106,13 @@ def test_padded_bucket_bit_identical(engine):
 
     # at the bucket's own shape the AOT executable IS the jit program —
     # bit-identical
-    np.testing.assert_array_equal(f8, np.asarray(raw(t["params"], imgs8)))
+    np.testing.assert_array_equal(f8, np.asarray(raw(params, imgs8)))
     # across batch shapes XLA may pick different kernels (f32 reduction
     # order), so the unpadded batch-5 program is equal to float32 eps —
     # the bit-level contract above already proves the padding itself can
     # never leak into a valid row
     np.testing.assert_allclose(
-        f5, np.asarray(raw(t["params"], imgs5)), rtol=1e-5, atol=1e-6
+        f5, np.asarray(raw(params, imgs5)), rtol=1e-5, atol=1e-6
     )
 
 
@@ -226,6 +245,15 @@ def test_restore_inference_state_skips_optimizer(tmp_path):
     restored = jax.tree_util.tree_leaves(params)
     assert len(saved) == len(restored)
     for a, b in zip(saved, restored):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+    # to_device=True lands every leaf on a device (incrementally — one
+    # host buffer in flight at a time) with identical values
+    params_dev, _ = restore_inference_state(str(tmp_path), to_device=True)
+    dev_leaves = jax.tree_util.tree_leaves(params_dev)
+    assert len(dev_leaves) == len(saved)
+    for a, b in zip(saved, dev_leaves):
+        assert isinstance(b, jax.Array)
         np.testing.assert_array_equal(a, np.asarray(b))
 
 
@@ -378,3 +406,124 @@ def test_warmup_first_does_not_deadlock():
     eng = InferenceEngine(tiny_cfg(), max_batch=2)
     assert eng.warmup(("features",), buckets=(1, 2)) == 2
     assert eng.warmup(("features",), buckets=(1, 2)) == 0  # cached now
+
+
+def test_warmup_parallel_compiles_each_bucket_exactly_once():
+    """The threaded warmup (compiles release the GIL) must produce exactly
+    one executable per (task, bucket) — the per-key locks serialize
+    duplicate claims, not the pool."""
+    compiles = []
+    eng = InferenceEngine(
+        tiny_cfg(), max_batch=8,
+        on_compile=lambda key, b: compiles.append((key, b)),
+    )
+    n = eng.warmup(("features",), workers=4)
+    assert n == 4 and sorted(b for _, b in compiles) == [1, 2, 4, 8]
+    assert all(c == 1 for c in eng.compile_counts.values())
+    # results must be served by those executables with zero extra compiles
+    out = eng.features(_images(5, seed=11))
+    assert out.shape[0] == 5 and len(compiles) == 4
+
+
+def test_warmup_rejects_oversized_bucket():
+    eng = InferenceEngine(tiny_cfg(), max_batch=4)
+    with pytest.raises(OversizedBatchError):
+        eng.warmup(("features",), buckets=(8,))
+
+
+def test_predict_rejects_non_shared_encoder_cache():
+    """per_sample masking draws per-row noise — encoder outputs depend on
+    batch position, so caching them would silently change results."""
+    with pytest.raises(ValueError, match="shared"):
+        InferenceEngine(
+            tiny_cfg(("model.overrides.mask_mode=per_sample",)),
+            max_batch=4,
+            encoder_cache=8,
+        )
+
+
+def test_encoder_cache_matches_fused_reconstruct():
+    """encode-once/decode-many must reproduce the fused executable's output
+    (same images, same seed) and hit on repeats."""
+    cfg = tiny_cfg()
+    fused = InferenceEngine(cfg, max_batch=4)
+    cached = InferenceEngine(cfg, max_batch=4, encoder_cache=8)
+    imgs = _images(3, seed=12)
+
+    ref = fused.reconstruct(imgs, seed=0)
+    out1 = cached.reconstruct(imgs, seed=0)
+    np.testing.assert_allclose(
+        np.asarray(out1["reconstruction"]),
+        np.asarray(ref["reconstruction"]),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out1["mask"]), np.asarray(ref["mask"])
+    )
+    st = cached.encoder_cache_stats()
+    assert st["misses"] == 3 and st["size"] == 3
+
+    # second pass: all encoder work served from the cache, bit-identical
+    out2 = cached.reconstruct(imgs, seed=0)
+    np.testing.assert_array_equal(
+        np.asarray(out1["reconstruction"]), np.asarray(out2["reconstruction"])
+    )
+    st = cached.encoder_cache_stats()
+    assert st["hits"] == 3 and st["misses"] == 3
+
+    # a different seed is a different mask — distinct cache entries, and
+    # the output actually changes
+    out3 = cached.reconstruct(imgs, seed=1)
+    assert not np.array_equal(
+        np.asarray(out1["mask"]), np.asarray(out3["mask"])
+    )
+    assert cached.encoder_cache_stats()["misses"] == 6
+
+
+def test_encoder_cache_evicts_lru():
+    eng = InferenceEngine(tiny_cfg(), max_batch=4, encoder_cache=2)
+    a, b, c = (_images(1, seed=s) for s in (20, 21, 22))
+    eng.reconstruct(a, seed=0)
+    eng.reconstruct(b, seed=0)  # cache: {a, b}
+    eng.reconstruct(c, seed=0)  # evicts a → {b, c}
+    st = eng.encoder_cache_stats()
+    assert st["size"] == 2 and st["misses"] == 3
+    eng.reconstruct(b, seed=0)  # hit
+    eng.reconstruct(a, seed=0)  # miss again (was evicted)
+    st = eng.encoder_cache_stats()
+    assert st["hits"] == 1 and st["misses"] == 4
+
+
+def test_encoder_cache_dedupes_within_batch():
+    """Duplicate rows in ONE request encode once and decode per-row."""
+    eng = InferenceEngine(tiny_cfg(), max_batch=4, encoder_cache=8)
+    img = _images(1, seed=23)
+    batch = np.concatenate([img, img, img])
+    out = eng.reconstruct(batch, seed=0)
+    assert out["reconstruction"].shape[0] == 3
+    np.testing.assert_array_equal(
+        np.asarray(out["reconstruction"][0]),
+        np.asarray(out["reconstruction"][2]),
+    )
+    assert eng.encoder_cache_stats()["misses"] == 1
+
+
+def test_microbatcher_pass_meta():
+    """pass_meta=True hands run_fn the per-request metadata, batch-aligned —
+    the hook a server uses to route per-request options through coalescing."""
+    seen = []
+
+    def run_fn(batch, metas):
+        seen.append(list(metas))
+        return batch.sum(axis=(1, 2, 3))
+
+    with MicroBatcher(
+        run_fn, max_batch=4, max_delay_ms=50.0, pass_meta=True
+    ) as mb:
+        futs = [
+            mb.submit(np.full((2, 2, 1), i), meta={"req": i}) for i in range(3)
+        ]
+        vals = [f.result(timeout=5) for f in futs]
+    assert vals == [0.0, 4.0, 8.0]
+    flat = [m for batch in seen for m in batch]
+    assert flat == [{"req": 0}, {"req": 1}, {"req": 2}]

@@ -1,0 +1,192 @@
+"""Weight-only int8 PTQ contracts (infer/quant.py).
+
+What serving relies on:
+
+- the quantization RULE is structural — matmul/projection kernels become
+  int8 + per-output-channel f32 scales; embeddings, norms, biases, tokens
+  stay f32 untouched;
+- the round-trip error is bounded by construction (|w - deq| ≤ scale/2);
+- engine parity vs the f32 reference is inside the published tolerance
+  (feature cosine / logits top-1) — the same check bench_infer and CI run;
+- padded-bucket inference stays provably inert THROUGH the quantized
+  executables: dequant is per-channel (row-independent), so the padding
+  bit-identity contract survives quantization unchanged.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.config import load_config
+from jumbo_mae_tpu_tpu.infer import InferenceEngine, QuantizedTensor, parity_report
+from jumbo_mae_tpu_tpu.infer.quant import (
+    FEATURE_COSINE_MIN,
+    TOP1_AGREEMENT_MIN,
+    dequantize_tree,
+    feature_cosine,
+    is_quantized,
+    quantize_params,
+    quantize_tensor,
+    top1_agreement,
+)
+
+RECIPE_OVERRIDES = [
+    "model.overrides.dtype=float32",
+    "model.dec_layers=1",
+    "model.dec_dim=32",
+    "model.dec_heads=2",
+    "model.dec_dtype=float32",
+]
+
+
+def tiny_cfg(extra=()):
+    from pathlib import Path
+
+    recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    return load_config(recipe, RECIPE_OVERRIDES + list(extra))
+
+
+def _images(n, size=32, seed=0):
+    return (
+        np.random.RandomState(seed).randint(0, 256, (n, size, size, 3))
+    ).astype(np.uint8)
+
+
+# ------------------------------------------------------------ tensor level
+
+
+def test_quantize_tensor_round_trip_bound():
+    w = np.random.RandomState(0).randn(64, 32).astype(np.float32)
+    qt = quantize_tensor(jnp.asarray(w), axes=(0,))
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (1, 32)
+    deq = np.asarray(qt.dequantize(jnp.float32))
+    # symmetric rounding: per-element error is at most half a step
+    err = np.abs(deq - w)
+    bound = np.asarray(qt.scale) / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_quantize_tensor_zero_channel_safe():
+    """An all-zero output channel must not divide by zero — scale falls back
+    to 1.0 and the channel round-trips to exact zeros."""
+    w = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    w[:, 2] = 0.0
+    qt = quantize_tensor(jnp.asarray(w), axes=(0,))
+    assert float(np.asarray(qt.scale)[0, 2]) == 1.0
+    deq = np.asarray(qt.dequantize(jnp.float32))
+    np.testing.assert_array_equal(deq[:, 2], 0.0)
+
+
+def test_quantized_tensor_is_jit_argument():
+    """QuantizedTensor is a registered pytree — it crosses the jit boundary
+    as an argument (the property the warmcache-shared executables need)."""
+    w = np.random.RandomState(2).randn(8, 8).astype(np.float32)
+    qt = quantize_tensor(jnp.asarray(w), axes=(0,))
+
+    @jax.jit
+    def apply(qt, x):
+        return x @ qt.dequantize(jnp.float32)
+
+    x = np.random.RandomState(3).randn(2, 8).astype(np.float32)
+    out = np.asarray(apply(qt, x))
+    ref = x @ np.asarray(qt.dequantize(jnp.float32))
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+# -------------------------------------------------------------- tree level
+
+
+def test_quantize_params_rule_is_structural():
+    """Only ndim≥2 'kernel' leaves quantize; everything else passes through
+    untouched (same object class, same values)."""
+    eng = InferenceEngine(tiny_cfg(), max_batch=2, warm_cache=False)
+    params = eng._task("features")["variables"]["params"]
+    qtree, report = quantize_params(params)
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        qtree, is_leaf=is_quantized
+    )[0]
+    n_q = n_f = 0
+    for path, leaf in flat:
+        names = [getattr(k, "key", getattr(k, "name", "")) for k in path]
+        if is_quantized(leaf):
+            n_q += 1
+            assert names[-1] == "kernel" and leaf.q.ndim >= 2
+            # per-output-channel: the scale broadcasts over reduction axes
+            # only — the last axis (or last two for fused qkv heads) keeps
+            # its full extent
+            assert leaf.scale.shape[-1] == leaf.q.shape[-1]
+        else:
+            n_f += 1
+            assert jnp.asarray(leaf).dtype != jnp.int8
+    assert n_q == report["n_quantized"] and n_f == report["n_kept"]
+    assert n_q > 0 and report["compression"] > 3.0
+
+    # dequantize_tree reproduces the full tree structure with f32 leaves
+    deq = dequantize_tree(qtree)
+    assert jax.tree_util.tree_structure(deq) == jax.tree_util.tree_structure(
+        params
+    )
+
+
+def test_quantize_params_idempotent_on_quantized_tree():
+    """Running the quantizer over an already-quantized tree must refuse
+    rather than double-quantize."""
+    eng = InferenceEngine(tiny_cfg(), max_batch=2, warm_cache=False)
+    params = eng._task("features")["variables"]["params"]
+    qtree, _ = quantize_params(params)
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_params(qtree)
+
+
+# ------------------------------------------------------------ engine level
+
+
+def test_engine_int8_parity_within_tolerance():
+    """The published parity contract: pooled-feature cosine ≥
+    FEATURE_COSINE_MIN and logits top-1 agreement ≥ TOP1_AGREEMENT_MIN
+    against the f32 engine on the same checkpoint."""
+    cfg = tiny_cfg()
+    ref = InferenceEngine(cfg, max_batch=4, labels=13, warm_cache=False)
+    q = InferenceEngine(
+        cfg, max_batch=4, labels=13, quant="int8", warm_cache=False
+    )
+    imgs = _images(8, seed=6)
+
+    feats = parity_report(ref, q, imgs, task="features", pool="cls")
+    assert feats["within_tolerance"], feats
+    assert feats["cosine_min"] >= FEATURE_COSINE_MIN
+
+    logits = parity_report(ref, q, imgs, task="logits")
+    assert logits["within_tolerance"], logits
+    assert logits["top1_agreement"] >= TOP1_AGREEMENT_MIN
+
+
+def test_engine_int8_padding_inert():
+    """The padded-bucket bit-identity contract holds through the int8
+    executables: dequant is per-channel, so pad rows cannot leak."""
+    eng = InferenceEngine(
+        tiny_cfg(), max_batch=8, quant="int8", warm_cache=False
+    )
+    imgs8 = _images(8, seed=7)
+    f5 = eng.features(imgs8[:5])  # bucket 8, rows 5..7 zero-padded
+    f8 = eng.features(imgs8)  # same bucket, rows 5..7 real images
+    np.testing.assert_array_equal(f5, f8[:5])
+
+
+def test_engine_rejects_unknown_quant():
+    with pytest.raises(ValueError, match="quant"):
+        InferenceEngine(tiny_cfg(), max_batch=2, quant="int4")
+
+
+# --------------------------------------------------------------- reporting
+
+
+def test_parity_helpers():
+    a = np.eye(4, dtype=np.float32)
+    assert feature_cosine(a, a).min() >= 1.0 - 1e-12
+    logits = np.asarray([[0.1, 0.9], [0.8, 0.2]], np.float32)
+    flipped = logits[:, ::-1]
+    assert top1_agreement(logits, logits) == 1.0
+    assert top1_agreement(logits, flipped) == 0.0

@@ -1,0 +1,221 @@
+"""Persistent warm-start cache contracts (infer/warmcache.py).
+
+The failure the design exists to prevent is documented in conftest: this
+jaxlib SIGABRTs the whole process deserializing a truncated XLA:CPU cache
+entry, and jax's internal cache writes non-atomically. So the properties
+under test are exactly the crash-safety ones:
+
+- a corrupt entry (truncated, flipped bytes, bad magic, garbage pickle) is
+  a MISS plus a quarantine move — never an exception, never a crash;
+- writes are atomic and uniquely-tmp'd — concurrent writers cannot leave a
+  partial entry, and no ``.tmp`` debris survives;
+- a second engine against a populated cache serves real traffic with ZERO
+  compiles (the restart contract CI asserts end-to-end via the probe CLI).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.config import load_config
+from jumbo_mae_tpu_tpu.infer import InferenceEngine, WarmCache
+from jumbo_mae_tpu_tpu.infer.warmcache import MAGIC, entry_name, fingerprint
+
+RECIPE_OVERRIDES = [
+    "model.overrides.dtype=float32",
+    "model.dec_layers=1",
+    "model.dec_dim=32",
+    "model.dec_heads=2",
+    "model.dec_dtype=float32",
+]
+
+
+def tiny_cfg(extra=()):
+    from pathlib import Path
+
+    recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    return load_config(recipe, RECIPE_OVERRIDES + list(extra))
+
+
+def _images(n, size=32, seed=0):
+    return (
+        np.random.RandomState(seed).randint(0, 256, (n, size, size, 3))
+    ).astype(np.uint8)
+
+
+def _tiny_executable(mul=2.0):
+    fn = jax.jit(lambda x: x * mul)
+    return fn.lower(jnp.zeros((2, 3), jnp.float32)).compile()
+
+
+# ------------------------------------------------------------- key schema
+
+
+def test_fingerprint_stable_and_sensitive():
+    spec = {"dim": 192, "depth": 12, "backend": "cpu"}
+    assert fingerprint(spec) == fingerprint(dict(reversed(spec.items())))
+    assert fingerprint(spec) != fingerprint({**spec, "dim": 384})
+
+
+def test_entry_name_schema_and_sanitization():
+    name = entry_name("abc123", "features:cls", 8, "float32", None)
+    assert name == "abc123-features_cls-b8-float32-none.exe"
+    assert entry_name("f", "logits", 4, "bfloat16", "int8").endswith(
+        "-b4-bfloat16-int8.exe"
+    )
+    # path metacharacters cannot escape the cache dir
+    hostile = entry_name("../..", "a/b\\c", 1, "f32 ", "x\n")
+    assert "/" not in hostile and "\\" not in hostile and "\n" not in hostile
+
+
+# ---------------------------------------------------------- put/get cycle
+
+
+def test_put_get_round_trip(tmp_path):
+    wc = WarmCache(tmp_path)
+    ex = _tiny_executable(3.0)
+    assert wc.put("t-b2-f32-none.exe", ex)
+    loaded = wc.get("t-b2-f32-none.exe")
+    assert loaded is not None
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_array_equal(np.asarray(loaded(x)), x * 3.0)
+    assert wc.stats()["entries"] == 1 and wc.stats()["hits"] == 1
+    # no tmp debris from the atomic write
+    assert not list(tmp_path.glob("*.tmp")) and not list(tmp_path.glob(".*"))
+
+
+def test_missing_entry_is_a_miss(tmp_path):
+    wc = WarmCache(tmp_path)
+    assert wc.get("nope.exe") is None
+    assert wc.stats()["misses"] == 1 and wc.stats()["quarantined"] == 0
+
+
+@pytest.mark.parametrize(
+    "corruption",
+    ["truncate", "flip_payload", "bad_magic", "garbage"],
+)
+def test_corrupt_entry_quarantined_not_fatal(tmp_path, corruption):
+    """Every corruption mode degrades to a miss + quarantine move; nothing
+    reaches XLA's deserializer (the SIGABRT path) without a digest match."""
+    wc = WarmCache(tmp_path)
+    name = "t-b2-f32-none.exe"
+    assert wc.put(name, _tiny_executable())
+    path = tmp_path / name
+    blob = bytearray(path.read_bytes())
+    if corruption == "truncate":
+        blob = blob[: len(blob) // 2]
+    elif corruption == "flip_payload":
+        blob[-1] ^= 0xFF
+    elif corruption == "bad_magic":
+        blob[:4] = b"XXXX"
+    else:
+        blob = bytearray(b"not a cache entry")
+    path.write_bytes(bytes(blob))
+
+    assert wc.get(name) is None
+    assert wc.stats()["quarantined"] == 1
+    assert not path.exists()  # moved aside, not retried forever
+    assert len(list((tmp_path / "quarantine").iterdir())) == 1
+    # the slot is writable again after quarantine
+    assert wc.put(name, _tiny_executable())
+    assert wc.get(name) is not None
+
+
+def test_digest_guards_payload_not_just_length(tmp_path):
+    """A same-length bit flip inside the payload must fail the sha256 check
+    (length checks alone would hand XLA corrupt bytes)."""
+    wc = WarmCache(tmp_path)
+    name = "t-b1-f32-none.exe"
+    wc.put(name, _tiny_executable())
+    path = tmp_path / name
+    blob = bytearray(path.read_bytes())
+    mid = len(MAGIC) + 32 + (len(blob) - len(MAGIC) - 32) // 2
+    blob[mid] ^= 0x01
+    path.write_bytes(bytes(blob))
+    assert wc.get(name) is None and wc.stats()["quarantined"] == 1
+
+
+def test_concurrent_writers_last_writer_wins(tmp_path):
+    """N threads publishing the same entry name race safely: afterwards the
+    entry is complete and loadable and no tmp files remain."""
+    wc = WarmCache(tmp_path)
+    ex = _tiny_executable(5.0)
+    errs = []
+
+    def writer():
+        try:
+            assert wc.put("race-b2-f32-none.exe", ex)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    loaded = wc.get("race-b2-f32-none.exe")
+    x = np.ones((2, 3), np.float32)
+    np.testing.assert_array_equal(np.asarray(loaded(x)), x * 5.0)
+    assert not list(tmp_path.glob("*.tmp")) and not list(tmp_path.glob(".*tmp"))
+
+
+# ------------------------------------------------------------ engine level
+
+
+def test_restarted_engine_compiles_nothing(tmp_path):
+    """The restart contract: engine A compiles + publishes; engine B (same
+    config, same cache dir — a restarted replica) warms up and serves real
+    traffic with zero compiles, and its outputs match A's bit-for-bit."""
+    cfg = tiny_cfg()
+    imgs = _images(5, seed=30)
+
+    a = InferenceEngine(cfg, max_batch=4, warm_cache=str(tmp_path))
+    n_cold = a.warmup(("features",))
+    assert n_cold == 3  # buckets 1, 2, 4
+    ref = a.features(imgs)
+    assert a.warmcache.stats()["puts"] == n_cold
+
+    b = InferenceEngine(cfg, max_batch=4, warm_cache=str(tmp_path))
+    n_warm = b.warmup(("features",))
+    assert n_warm == 0
+    assert sum(b.warm_hits.values()) == n_cold
+    out = b.features(imgs)
+    assert sum(b.compile_counts.values()) == 0  # hot path compiled nothing
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_quant_and_dtype_key_separate_entries(tmp_path):
+    """int8 and f32 engines sharing one cache dir must not collide — quant
+    mode is part of the entry key."""
+    cfg = tiny_cfg()
+    f32 = InferenceEngine(cfg, max_batch=2, warm_cache=str(tmp_path))
+    f32.warmup(("features",), buckets=(2,))
+    q = InferenceEngine(
+        cfg, max_batch=2, quant="int8", warm_cache=str(tmp_path)
+    )
+    n = q.warmup(("features",), buckets=(2,))
+    assert n == 1  # the f32 entry was not (wrongly) reused
+    names = {p.name for p in tmp_path.glob("*.exe")}
+    assert len(names) == 2
+    assert any("-int8" in n for n in names)
+    assert any("-none" in n for n in names)
+
+
+def test_corrupt_cache_entry_degrades_to_compile(tmp_path):
+    """An engine pointed at a poisoned cache recompiles and republishes —
+    serving survives, the bad entry lands in quarantine/."""
+    cfg = tiny_cfg()
+    a = InferenceEngine(cfg, max_batch=2, warm_cache=str(tmp_path))
+    a.warmup(("features",), buckets=(2,))
+    entry = next(tmp_path.glob("*.exe"))
+    entry.write_bytes(b"JWC1" + b"\0" * 40)  # valid-looking, corrupt
+
+    b = InferenceEngine(cfg, max_batch=2, warm_cache=str(tmp_path))
+    assert b.warmup(("features",), buckets=(2,)) == 1  # recompiled
+    assert b.warmcache.stats()["quarantined"] == 1
+    out = b.features(_images(2, seed=31))
+    assert np.isfinite(out).all()

@@ -94,7 +94,7 @@ def extract_arrays(cfg, ckpt: str, pool: str):
     n_dev = len(jax.devices())
     per_batch = -(-max(1, cfg.run.valid_batch_size) // n_dev) * n_dev
     engine = InferenceEngine(
-        cfg, ckpt=ckpt, max_batch=bucket_for(per_batch, 1024)
+        cfg, ckpt=ckpt, max_batch=bucket_for(min(per_batch, 1024), 1024)
     )
     valid_factory = make_valid_iterator(
         cfg, mesh, per_batch, num_labels=recipe_labels or 1000
