@@ -455,6 +455,28 @@ _LEG_TRANSIENT = (
 )
 
 
+# XLA cost analysis per measured leg ("<dtype>-b<batch>" → cost dict),
+# recorded by _measure_leg as a side table: the ledger row wants the costs,
+# but _measure_leg's float return is load-bearing for its callers/tests.
+_LEG_COSTS: dict = {}
+
+
+def _record_leg_cost(key: str, step, batch_size: int) -> None:
+    """Best-effort: read XLA's cost analysis off the leg's train-step
+    executable (the AOT dispatch in train/steps exposes it — no recompile)."""
+    try:
+        from jumbo_mae_tpu_tpu.obs.costmodel import cost_asdict, extract_cost
+
+        execs = getattr(step, "executables", None) or {}
+        for ex in execs.values():
+            cost = extract_cost(ex, "train_step")
+            if cost is not None:
+                _LEG_COSTS[key] = cost_asdict(cost) | {"batch": batch_size}
+            break
+    except Exception:  # noqa: BLE001 — observability must not fail a leg
+        pass
+
+
 def _measure_leg(dtype: str, batch_size: int, model: str, iters: int) -> float:
     """Build + time one bench leg, retrying transient tunnel faults.
 
@@ -466,7 +488,7 @@ def _measure_leg(dtype: str, batch_size: int, model: str, iters: int) -> float:
         step = state = batch = None
         try:
             step, state, batch, floor = build_step(dtype, batch_size, model)
-            return time_steps(
+            dt = time_steps(
                 step,
                 state,
                 batch,
@@ -474,6 +496,8 @@ def _measure_leg(dtype: str, batch_size: int, model: str, iters: int) -> float:
                 iters=iters,
                 min_plausible_ms=floor,
             )
+            _record_leg_cost(f"{dtype}-b{batch_size}", step, batch_size)
+            return dt
         except Exception as exc:  # noqa: BLE001 — classify then re-raise
             # drop the failed attempt's device buffers BEFORE rebuilding —
             # otherwise the retry allocates a second full param/opt/batch
@@ -545,7 +569,63 @@ def _run_bench() -> dict:
             result["f32_batch"] = batch_f32
             dt_eq = _measure_leg("bfloat16", batch_f32, model, iters)
             result["vs_baseline_equal_batch"] = round(dt_f32 / dt_eq, 3)
+    _append_ledger(result, batch_size)
     return result
+
+
+def _append_ledger(result: dict, batch_size: int) -> None:
+    """Land this round in BENCH_HISTORY.jsonl (``obs/perfledger``): legs,
+    the XLA-extracted bf16-leg cost, and its roofline prediction. Best
+    effort — the one-JSON-line stdout contract is unaffected either way."""
+    try:
+        from jumbo_mae_tpu_tpu.obs.perfledger import (
+            append_row,
+            make_row,
+            resolve_history_path,
+        )
+
+        path = resolve_history_path()
+        if path is None:
+            return
+        legs = {
+            k: result[k]
+            for k in (
+                "value",
+                "ms_step_bf16",
+                "ms_step_f32",
+                "vs_baseline",
+                "vs_baseline_equal_batch",
+            )
+            if result.get(k) is not None
+        }
+        prediction = None
+        cost = _LEG_COSTS.get(f"bfloat16-b{batch_size}")
+        if cost:
+            from jumbo_mae_tpu_tpu.obs.perfmodel import (
+                detect_chip,
+                prediction_asdict,
+                roofline,
+            )
+
+            pred = roofline(
+                cost["flops"],
+                cost["bytes_accessed"],
+                detect_chip(),
+                batch=cost.get("batch"),
+                peak_hbm_bytes=cost.get("peak_bytes", 0.0),
+            )
+            prediction = prediction_asdict(pred)
+        row = make_row(
+            bench="train",
+            metric=result["metric"],
+            legs=legs,
+            prediction=prediction,
+            extra={"unit": result.get("unit"), "cost": cost},
+        )
+        if append_row(path, row):
+            print(f"bench: ledger row -> {path}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the ledger must not fail a bench
+        print(f"bench: ledger append failed: {e}", file=sys.stderr)
 
 
 def main():

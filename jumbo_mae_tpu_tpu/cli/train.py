@@ -78,6 +78,13 @@ from jumbo_mae_tpu_tpu.obs import (
     stats_dict,
     trace,
 )
+from jumbo_mae_tpu_tpu.obs.costmodel import (
+    cost_asdict,
+    extract_cost,
+    publish_cost,
+    utilization_report,
+)
+from jumbo_mae_tpu_tpu.obs.perfmodel import detect_chip, publish_drift, roofline
 from jumbo_mae_tpu_tpu.utils import (
     AverageMeter,
     MetricLogger,
@@ -785,6 +792,16 @@ def train(cfg: TrainConfig) -> dict:
         "train_grad_norm", "global gradient norm of the last fetched step"
     )
     c_steps = reg.counter("train_steps_total", "optimizer steps this process")
+    g_hfu = reg.gauge(
+        "train_hardware_flops_utilization",
+        "XLA-counted flops (remat recompute included) / peak (log-window)",
+    )
+    # compiled-cost observability: the AOT dispatch in train/steps exposes
+    # the step's executable, so XLA's cost/memory analysis is a free readout
+    # — no second compile. Extracted once at the first log boundary,
+    # journaled, and folded into the MFU/HFU split + drift gauge below.
+    step_cost = None  # None = not yet extracted, False = gave up
+    chip = detect_chip()
     sp_wait = span_timer("data_wait")
     sp_step = span_timer("train_step")
     sp_ckpt = span_timer("checkpoint_save")
@@ -889,6 +906,26 @@ def train(cfg: TrainConfig) -> dict:
                                 flightrec.record_step(ds, {"diag": latest_diag[1]})
                         diag_pending.clear()
                     summary = meter.summary("train/")
+                    if step_cost is None:
+                        execs = getattr(train_step, "executables", None)
+                        if execs:
+                            cost = extract_cost(
+                                next(iter(execs.values())), "train_step"
+                            )
+                            if cost is not None:
+                                step_cost = cost
+                                publish_cost(
+                                    cost,
+                                    bucket="",
+                                    dtype=cfg.model.overrides.get("dtype", ""),
+                                )
+                                _emit(
+                                    "compiled_program",
+                                    batch=run.train_batch_size,
+                                    **cost_asdict(cost),
+                                )
+                            else:
+                                step_cost = False  # backend reported nothing
                     sps = timer.steps_per_sec
                     if sps:
                         imgs = sps * run.train_batch_size
@@ -901,6 +938,34 @@ def train(cfg: TrainConfig) -> dict:
                         }
                         g_mfu.set(rep.mfu)
                         g_ips.set(imgs)
+                        if step_cost:
+                            # MFU (analytic model flops) vs HFU (XLA-counted,
+                            # remat recompute included) + roofline drift
+                            util = utilization_report(
+                                flops_per_image * run.train_batch_size,
+                                step_cost.flops,
+                                sps,
+                                n_chips=n_chips,
+                                peak_tflops=rep.peak_tflops,
+                            )
+                            pred = roofline(
+                                step_cost.flops,
+                                step_cost.bytes_accessed,
+                                chip,
+                                peak_hbm_bytes=step_cost.peak_bytes,
+                            )
+                            drift = publish_drift(
+                                pred.step_time_s, 1.0 / sps, program="train_step"
+                            )
+                            summary |= {
+                                "perf/model_flops_utilization": rep.mfu,
+                                "perf/hardware_flops_utilization": (
+                                    util.hardware_flops_utilization
+                                ),
+                                "perf/predicted_step_ms": pred.step_time_s * 1e3,
+                                "perf/predict_vs_measured": drift,
+                            }
+                            g_hfu.set(util.hardware_flops_utilization)
                     now = time.perf_counter()
                     wait_frac = window_wait / max(now - window_t0, 1e-9)
                     g_wait_frac.set(wait_frac)

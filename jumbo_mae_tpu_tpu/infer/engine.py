@@ -257,6 +257,32 @@ class InferenceEngine:
             "params bytes_before / bytes_after per quantized task",
             labels=("task",),
         )
+        self._m_bucket_compile = reg.gauge(
+            "infer_bucket_compile_seconds",
+            "lower+compile wall time of each (task, bucket) executable",
+            labels=("task", "bucket"),
+        )
+        self._m_exec_bytes = reg.gauge(
+            "infer_executable_bytes",
+            "serialized executable size per (task, bucket)",
+            labels=("task", "bucket"),
+        )
+        self._m_warm_saved = reg.counter(
+            "infer_warmcache_saved_seconds_total",
+            "compile seconds avoided by warmcache hits (from entry metadata)",
+            labels=("task",),
+        )
+        self._m_pred_s = reg.gauge(
+            "perf_predicted_step_seconds",
+            "roofline-predicted execution seconds",
+            labels=("program",),
+        )
+        self._m_drift = reg.gauge(
+            "perf_predict_vs_measured",
+            "measured / roofline-predicted execution time",
+            labels=("program",),
+        )
+        self._registry = reg
         self.cfg = cfg
         self.max_batch = int(max_batch)
         self.on_compile = on_compile
@@ -346,6 +372,11 @@ class InferenceEngine:
         self._tasks: dict[str, dict] = {}  # task -> {model, variables, ...}
         self._exec: dict[tuple[str, int], Any] = {}
         self.compile_counts: dict[tuple[str, int], int] = {}
+        # XLA cost analysis per (task_key, bucket) + its roofline-predicted
+        # execution seconds — filled at compile/warm-load time, read by the
+        # per-dispatch drift gauge and bench_infer's ledger row
+        self.cost_reports: dict[tuple[str, int], Any] = {}
+        self._pred_s: dict[tuple[str, int], float] = {}
         self._lock = threading.Lock()
         # one lock per (task, bucket): warmup threads compile distinct
         # executables concurrently (XLA releases the GIL) while two racers
@@ -646,13 +677,25 @@ class InferenceEngine:
                 self._m_hits.labels(key[0]).inc()
                 return ex
             if self.warmcache is not None:
-                ex = self.warmcache.get(self._entry_name(key[0], bucket))
+                name = self._entry_name(key[0], bucket)
+                ex = self.warmcache.get(name)
                 if ex is not None:
                     # a warm-start load, not a compile: compile_counts must
                     # stay flat so "restart performs zero compiles" is a
                     # checkable invariant, and miss keeps meaning compile
                     self._exec[key] = ex
                     self.warm_hits[key] = self.warm_hits.get(key, 0) + 1
+                    self._publish_cost(key, ex)
+                    meta = self.warmcache.entry_meta(name)
+                    if meta:
+                        # quantify what the hit was worth: the compile
+                        # seconds the first process paid for this entry
+                        saved = float(meta.get("compile_seconds") or 0.0)
+                        if saved > 0:
+                            self._m_warm_saved.labels(key[0]).inc(saved)
+                        size = float(meta.get("executable_bytes") or 0.0)
+                        if size > 0:
+                            self._m_exec_bytes.labels(*map(str, key)).set(size)
                     return ex
             self._m_misses.labels(key[0]).inc()
             t_compile = time.perf_counter()
@@ -672,14 +715,53 @@ class InferenceEngine:
             )
             self._exec[key] = ex
             self.compile_counts[key] = self.compile_counts.get(key, 0) + 1
-            self._m_compile.labels(key[0]).observe(
-                time.perf_counter() - t_compile
-            )
+            compile_s = time.perf_counter() - t_compile
+            self._m_compile.labels(key[0]).observe(compile_s)
+            self._m_bucket_compile.labels(*map(str, key)).set(compile_s)
             if self.on_compile is not None:
                 self.on_compile(key[0], bucket)
+            cost = self._publish_cost(key, ex)
             if self.warmcache is not None:
-                self.warmcache.put(self._entry_name(key[0], bucket), ex)
+                meta = {"compile_seconds": round(compile_s, 4)}
+                if cost is not None:
+                    from jumbo_mae_tpu_tpu.obs.costmodel import cost_asdict
+
+                    meta["cost"] = cost_asdict(cost)
+                size = self.warmcache.put(
+                    self._entry_name(key[0], bucket), ex, meta=meta
+                )
+                if size:
+                    self._m_exec_bytes.labels(*map(str, key)).set(size)
             return ex
+
+    def _publish_cost(self, key: tuple[str, int], ex):
+        """Extract + publish XLA's cost analysis for one executable (at
+        compile or warm-load time, never per dispatch) and precompute its
+        roofline prediction for the drift gauge. Best-effort throughout."""
+        try:
+            from jumbo_mae_tpu_tpu.obs.costmodel import extract_cost, publish_cost
+            from jumbo_mae_tpu_tpu.obs.perfmodel import detect_chip, roofline
+
+            cost = extract_cost(ex, key[0])
+            if cost is None:
+                return None
+            dtype = str(self._enc.dtype) + (f"+{self.quant}" if self.quant else "")
+            publish_cost(
+                cost, bucket=str(key[1]), dtype=dtype, registry=self._registry
+            )
+            self.cost_reports[key] = cost
+            pred = roofline(
+                cost.flops,
+                cost.bytes_accessed,
+                detect_chip(),
+                batch=key[1],
+                peak_hbm_bytes=cost.peak_bytes,
+            )
+            self._pred_s[key] = pred.step_time_s
+            self._m_pred_s.labels(f"{key[0]}/b{key[1]}").set(pred.step_time_s)
+            return cost
+        except Exception:  # noqa: BLE001 — observability must not fail serving
+            return None
 
     def warmup(
         self,
@@ -757,6 +839,13 @@ class InferenceEngine:
         bd["bucket"] = max(bd["bucket"], bucket)
         bd["pad_rows"] += bucket - n
         bd["bucket_rows"] += bucket
+        # predicted-vs-measured drift: prediction precomputed at compile
+        # time, so the hot path pays one dict lookup + one gauge set
+        pred = self._pred_s.get((self._task_key(task, pool), bucket))
+        if pred:
+            self._m_drift.labels(f"{self._task_key(task, pool)}/b{bucket}").set(
+                (t_fetch - t_compute) / pred
+            )
         return out
 
     @staticmethod

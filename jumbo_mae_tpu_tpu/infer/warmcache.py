@@ -135,9 +135,15 @@ class WarmCache:
         self._m.labels("hit").inc()
         return ex
 
-    def put(self, name: str, compiled) -> bool:
+    def put(self, name: str, compiled, meta: dict | None = None) -> int:
         """Serialize + atomically publish one executable; best-effort (a
-        full disk or an unserializable program must not fail serving)."""
+        full disk or an unserializable program must not fail serving).
+
+        Returns the serialized blob size in bytes (0 on failure — callers
+        that only care whether the put landed keep working, callers that
+        gauge executable size get it for free). ``meta`` lands in a
+        ``<name>.meta.json`` sidecar (compile wall time, cost analysis) so
+        a warm-started process can credit what the hit saved it."""
         path = self.root / name
         tmp = None
         try:
@@ -156,10 +162,29 @@ class WarmCache:
             self.put_errors += 1
             self._m.labels("put_error").inc()
             print(f"[warmcache] put({name}) failed: {e}", file=sys.stderr)
-            return False
+            return 0
         self.puts += 1
         self._m.labels("put").inc()
-        return True
+        self._put_meta(name, {"executable_bytes": len(blob), **(meta or {})})
+        return len(blob)
+
+    def _put_meta(self, name: str, meta: dict) -> None:
+        """Atomic best-effort sidecar write; a corrupt/missing sidecar only
+        loses metadata, never the executable."""
+        path = self.root / f"{name}.meta.json"
+        tmp = path.with_name(f".{path.name}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp")
+        try:
+            tmp.write_text(json.dumps(meta, sort_keys=True, default=str))
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001
+            Path(tmp).unlink(missing_ok=True)
+
+    def entry_meta(self, name: str) -> dict | None:
+        """The ``put()`` metadata sidecar for one entry, or None."""
+        try:
+            return json.loads((self.root / f"{name}.meta.json").read_text())
+        except Exception:  # noqa: BLE001 — metadata is advisory
+            return None
 
     def _quarantine(self, path: Path, err: Exception):
         """Move a bad entry aside — kept for postmortem, never re-read."""

@@ -7,7 +7,16 @@
 - ``obs.trace``    — host-side spans aggregating into the registry, optional
   chrome-trace export, and the XLA device-trace capture helpers.
 - ``obs.mfu``      — analytic FLOPs + MFU reporting (fed into the registry
-  by the train loop).
+  by the train loop), with one device_kind normalizer for the peak-TFLOPS
+  tables.
+- ``obs.costmodel`` — XLA ``cost_analysis``/``memory_analysis`` extraction
+  for every compiled program (``xla_*`` gauges, journal events, MFU vs HFU
+  split).
+- ``obs.perfmodel`` — analytic roofline capacity model (predicted step time
+  / throughput / peak HBM; FSDP/DP comm terms) + the live
+  predict-vs-measured drift gauge.
+- ``obs.perfledger`` — schema-versioned BENCH_HISTORY.jsonl writer/reader
+  the benches append to and ``tools/perf_doctor.py`` diagnoses.
 - ``obs.modelstats`` — per-layer-group grad/param/update statistics computed
   inside the jitted train step (``run.diag_every``).
 - ``obs.journal``  — append-only crash-safe JSONL run journal + reader.
@@ -54,14 +63,44 @@ from jumbo_mae_tpu_tpu.obs.metrics import (
     get_registry,
     set_registry,
 )
+from jumbo_mae_tpu_tpu.obs.costmodel import (
+    COST_SCHEMA_VERSION,
+    ProgramCost,
+    UtilizationReport,
+    cost_asdict,
+    extract_cost,
+    publish_cost,
+    utilization_report,
+)
 from jumbo_mae_tpu_tpu.obs.mfu import (
     PEAK_TFLOPS,
     MfuReport,
     classify_flops_per_image,
     detect_peak_tflops,
     encoder_flops_per_image,
+    lookup_peak_tflops,
     mfu_report,
+    normalize_device_kind,
     pretrain_flops_per_image,
+)
+from jumbo_mae_tpu_tpu.obs.perfledger import (
+    LEDGER_SCHEMA,
+    append_row,
+    comparable_env,
+    make_row,
+    read_ledger,
+    resolve_history_path,
+)
+from jumbo_mae_tpu_tpu.obs.perfmodel import (
+    ChipSpec,
+    PerfPrediction,
+    chip_spec,
+    detect_chip,
+    dp_comm_bytes,
+    fsdp_comm_bytes,
+    predict_train_step,
+    publish_drift,
+    roofline,
 )
 from jumbo_mae_tpu_tpu.obs.reqtrace import (
     OUTCOMES,
@@ -83,6 +122,8 @@ from jumbo_mae_tpu_tpu.obs.trace import (
 __all__ = [
     "AccessLog",
     "AverageMeter",
+    "COST_SCHEMA_VERSION",
+    "ChipSpec",
     "Counter",
     "Family",
     "FlightRecorder",
@@ -90,12 +131,15 @@ __all__ = [
     "HealthState",
     "Histogram",
     "LATENCY_BUCKETS",
+    "LEDGER_SCHEMA",
     "MetricsRegistry",
     "MfuReport",
     "NULL_REGISTRY",
     "NullRegistry",
     "OUTCOMES",
     "PEAK_TFLOPS",
+    "PerfPrediction",
+    "ProgramCost",
     "RATIO_BUCKETS",
     "RequestTrace",
     "RequestTracer",
@@ -104,23 +148,41 @@ __all__ = [
     "SLOTracker",
     "STAT_NAMES",
     "TelemetryServer",
+    "UtilizationReport",
     "annotate",
+    "append_row",
+    "chip_spec",
     "classify_flops_per_image",
+    "comparable_env",
+    "cost_asdict",
+    "detect_chip",
     "detect_peak_tflops",
+    "dp_comm_bytes",
     "encoder_flops_per_image",
     "env_fingerprint",
     "export_chrome_trace",
+    "extract_cost",
     "first_nonfinite_group",
+    "fsdp_comm_bytes",
     "get_registry",
     "group_layout",
     "group_of",
     "group_stats",
     "journal_dir",
+    "lookup_peak_tflops",
+    "make_row",
     "mfu_report",
+    "normalize_device_kind",
     "parse_slo",
+    "predict_train_step",
     "pretrain_flops_per_image",
+    "publish_cost",
+    "publish_drift",
     "publish_group_stats",
     "read_journal",
+    "read_ledger",
+    "resolve_history_path",
+    "roofline",
     "set_registry",
     "span",
     "span_timer",
@@ -128,4 +190,5 @@ __all__ = [
     "stats_dict",
     "stop_chrome_trace",
     "trace",
+    "utilization_report",
 ]

@@ -10,6 +10,7 @@ through the metrics registry (``utils/mfu.py`` remains as a compat shim).
 
 from __future__ import annotations
 
+import sys
 from dataclasses import dataclass
 
 # Peak dense bf16 TFLOP/s per chip by TPU generation (public spec sheet
@@ -28,6 +29,54 @@ PEAK_TFLOPS = {
     "v6e": 918.0,
     "v6 lite": 918.0,
 }
+
+# The spelling aliases above all collapse onto one canonical generation —
+# every consumer (peak flops here, the HBM/ICI tables in ``obs/perfmodel``)
+# resolves device_kind through ONE normalizer so the "v5 lite never matched
+# v5e" bug class can't come back per-table.
+CANONICAL_KINDS = {"v5 lite": "v5e", "v5litepod": "v5e", "v6 lite": "v6e"}
+
+_warned_kinds: set[str] = set()
+
+
+def normalize_device_kind(kind: str) -> str | None:
+    """Map a raw PJRT ``device_kind`` string ('TPU v5 lite', 'TPU v4', ...)
+    to its canonical generation key ('v5e', 'v4'), or None if unmatched."""
+    k = str(kind).lower()
+    for gen in sorted(PEAK_TFLOPS, key=len, reverse=True):
+        if gen in k:
+            return CANONICAL_KINDS.get(gen, gen)
+    return None
+
+
+def lookup_peak_tflops(kind: str, default: float | None = None) -> float | None:
+    """Peak bf16 TFLOP/s for a device_kind string.
+
+    An unmatched kind is an observability event, not a silent default: warn
+    once per kind on stderr and set ``mfu_peak_unknown{kind}`` so a scrape
+    shows the timing-plausibility guard is running blind."""
+    gen = normalize_device_kind(kind)
+    if gen is not None:
+        return PEAK_TFLOPS[gen]
+    if kind not in _warned_kinds:
+        _warned_kinds.add(kind)
+        print(
+            f"[mfu] unknown device_kind {kind!r}: no peak-TFLOPS entry — "
+            f"MFU and timing-plausibility checks fall back to "
+            f"default={default}",
+            file=sys.stderr,
+        )
+        try:
+            from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+            get_registry().gauge(
+                "mfu_peak_unknown",
+                "1 when the backend device_kind has no PEAK_TFLOPS entry",
+                labels=("kind",),
+            ).labels(str(kind)).set(1)
+        except Exception:  # noqa: BLE001 - telemetry must not fail lookup
+            pass
+    return default
 
 
 def _attention_flops(seq: int, dim: int, *, causal: bool = False) -> float:
@@ -91,13 +140,11 @@ def detect_peak_tflops(default: float = 275.0) -> float:
     try:
         import jax
 
-        kind = jax.devices()[0].device_kind.lower()
+        kind = jax.devices()[0].device_kind
     except Exception:  # noqa: BLE001 - no backend → default
         return default
-    for gen in sorted(PEAK_TFLOPS, key=len, reverse=True):
-        if gen in kind:
-            return PEAK_TFLOPS[gen]
-    return default
+    peak = lookup_peak_tflops(kind, default=default)
+    return default if peak is None else peak
 
 
 @dataclass

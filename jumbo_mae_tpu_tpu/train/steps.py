@@ -370,13 +370,44 @@ def make_train_step(
 
     no_inject = np.ones(2, np.float32)
 
-    def train_step(state: TrainState, batch: dict, inject=None):
-        return _train_step(
-            state,
-            batch,
-            no_inject if inject is None else np.asarray(inject, np.float32),
+    # Dispatch through an AOT-compiled executable (lower().compile(), keyed
+    # by batch shapes) instead of the tracing jit wrapper. Two reasons:
+    # (1) cost observability — ``Compiled.cost_analysis()`` needs the
+    # executable in hand, and jax's AOT path is NOT deduped against the C++
+    # jit cache, so a post-hoc ``lower().compile()`` on an already-traced
+    # jit function would compile the whole program a second time;
+    # (2) it makes the train loop's compile point explicit, matching the
+    # serving engine's idiom. Any AOT failure degrades permanently to the
+    # plain jit path (``Compiled.__call__`` validates avals/shardings before
+    # buffers are donated, so falling back after a raise is safe).
+    aot: dict[tuple, Any] = {}
+    state_fallback = {"plain": False}
+
+    def _batch_key(batch: dict) -> tuple:
+        return tuple(
+            (k, tuple(v.shape), str(getattr(v, "dtype", type(v))))
+            for k, v in sorted(batch.items())
         )
 
+    def train_step(state: TrainState, batch: dict, inject=None):
+        inj = no_inject if inject is None else np.asarray(inject, np.float32)
+        if not state_fallback["plain"]:
+            key = _batch_key(batch)
+            compiled = aot.get(key)
+            if compiled is None:
+                try:
+                    compiled = _train_step.lower(state, batch, inj).compile()
+                    aot[key] = compiled
+                except Exception:  # noqa: BLE001 - AOT is an optimization
+                    state_fallback["plain"] = True
+            if compiled is not None:
+                try:
+                    return compiled(state, batch, inj)
+                except Exception:  # noqa: BLE001 - pre-execution validation
+                    state_fallback["plain"] = True
+        return _train_step(state, batch, inj)
+
+    train_step.executables = aot  # read by cli/train's cost extraction
     return train_step
 
 

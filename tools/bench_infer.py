@@ -115,6 +115,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--out", default="", help="also write the JSON here")
     p.add_argument(
+        "--history",
+        default=None,
+        metavar="PATH",
+        help="perf ledger to append one schema-versioned row to (default: "
+        "$BENCH_HISTORY or ./BENCH_HISTORY.jsonl; 'off' disables)",
+    )
+    p.add_argument(
         "--set",
         dest="overrides",
         metavar="KEY.PATH=VALUE",
@@ -454,11 +461,78 @@ def main(argv: list[str] | None = None) -> dict:
             "families_seen": [k for k in keys if k in scrape],
         }
         telemetry.close()
+    _append_ledger(args, report, engine)
     line = json.dumps(report)
     print(line)
     if args.out:
         Path(args.out).write_text(line + "\n")
     return report
+
+
+def _append_ledger(args, report: dict, engine) -> None:
+    """One BENCH_HISTORY.jsonl row for this bench: per-leg throughput, the
+    engine leg's exact latency quantiles, and the roofline prediction of the
+    largest-bucket executable (from the engine's compile-time cost reports).
+    Best-effort; the one-JSON-line stdout contract is unaffected."""
+    try:
+        from jumbo_mae_tpu_tpu.obs.perfledger import (
+            append_row,
+            make_row,
+            resolve_history_path,
+        )
+
+        path = resolve_history_path(args.history)
+        if path is None:
+            return
+        legs = {"naive_imgs_per_sec": report["naive"]["imgs_per_sec"],
+                "engine_imgs_per_sec": report["engine"]["imgs_per_sec"]}
+        if report.get("engine_int8"):
+            legs["engine_int8_imgs_per_sec"] = report["engine_int8"][
+                "imgs_per_sec"
+            ]
+        quantiles = {
+            k: report["engine"][k]
+            for k in ("p50_ms", "p99_ms", "mean_ms")
+            if isinstance(report["engine"].get(k), (int, float))
+        }
+        prediction = None
+        if getattr(engine, "cost_reports", None):
+            from jumbo_mae_tpu_tpu.obs.costmodel import cost_asdict
+            from jumbo_mae_tpu_tpu.obs.perfmodel import (
+                detect_chip,
+                prediction_asdict,
+                roofline,
+            )
+
+            key = max(engine.cost_reports, key=lambda k: k[1])
+            cost = engine.cost_reports[key]
+            pred = roofline(
+                cost.flops,
+                cost.bytes_accessed,
+                detect_chip(),
+                batch=key[1],
+                peak_hbm_bytes=cost.peak_bytes,
+            )
+            prediction = prediction_asdict(pred) | {
+                "program": f"{key[0]}/b{key[1]}",
+                "cost": cost_asdict(cost),
+            }
+        metric = (
+            f"infer_{report['model']}_{report['image_size']}_"
+            f"{report['task']}_imgs_per_sec"
+        )
+        row = make_row(
+            bench="infer",
+            metric=metric,
+            legs=legs,
+            quantiles=quantiles,
+            prediction=prediction,
+            extra={"max_batch": report["max_batch"]},
+        )
+        if append_row(path, row):
+            print(f"bench_infer: ledger row -> {path}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — the ledger must not fail a bench
+        print(f"bench_infer: ledger append failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

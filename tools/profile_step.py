@@ -12,7 +12,11 @@ Capture runs through the ``obs/trace.py`` helpers (the same ones
 ``run.profile_dir`` / ``run.chrome_trace`` use), so alongside the XLA
 device trace it writes a host-side span timeline
 (``<out>/host_spans.trace.json``) in the SAME chrome-trace format as a
-training run's ``run.chrome_trace`` — one toolchain opens both.
+training run's ``run.chrome_trace`` — and merges both onto ONE timeline
+(``<out>/combined.trace.json``: device tracks + a 'host spans' track) so
+a single Perfetto tab shows dispatch gaps against device programs.
+``--journal RUN_DIR`` appends a ``profile`` event with the artifact paths
+to the run's journal, so ``run_doctor`` can point at the capture.
 
 The reference had no profiling surface at all (SURVEY §5).
 """
@@ -78,6 +82,52 @@ def capture(
     if not traces:
         raise FileNotFoundError(f"no trace written under {out_dir}")
     return max(traces, key=os.path.getmtime), str(host_trace)
+
+
+def merge_traces(device_trace: str, host_trace: str, out_path: str) -> str:
+    """One combined chrome-trace JSON: the XLA device tracks plus the host
+    span track on a single timeline.
+
+    The two captures use different clock origins (host spans stamp
+    ``time.perf_counter``; the device trace has its own epoch), so host
+    events are shifted to share the device trace's origin — within-capture
+    ordering is exact, cross-capture alignment is to the capture window.
+    Host events land under their own pid with a process_name so Perfetto
+    shows them as a separate 'host spans' track.
+    """
+    with gzip.open(device_trace, "rt") as f:
+        combined = json.load(f)
+    events = combined.setdefault("traceEvents", [])
+    with open(host_trace) as f:
+        host_events = [
+            e for e in json.load(f).get("traceEvents", []) if e.get("ph") == "X"
+        ]
+    if host_events:
+        dev_ts = [e["ts"] for e in events if e.get("ph") == "X" and "ts" in e]
+        shift = (min(dev_ts) if dev_ts else 0.0) - min(
+            e["ts"] for e in host_events
+        )
+        host_pid = max(
+            [e.get("pid", 0) for e in events if isinstance(e.get("pid"), int)],
+            default=0,
+        ) + 1
+        events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": host_pid,
+                "args": {"name": "host spans (obs/trace)"},
+            }
+        )
+        for e in host_events:
+            events.append({**e, "ts": e["ts"] + shift, "pid": host_pid})
+    combined.setdefault("displayTimeUnit", "ms")
+    out = os.path.join(out_path, "combined.trace.json") if os.path.isdir(
+        out_path
+    ) else out_path
+    with open(out, "w") as f:
+        json.dump(combined, f)
+    return out
 
 
 def aggregate(trace_path: str, steps: int) -> tuple[dict, list, list, list]:
@@ -165,13 +215,34 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="skip capture; aggregate an existing .trace.json.gz",
     )
+    parser.add_argument(
+        "--journal",
+        default=None,
+        help="run dir / journal dir: append a 'profile' event with the "
+        "artifact paths so run_doctor can point at this capture",
+    )
     args = parser.parse_args(argv)
 
-    host_path = None
+    host_path = combined = None
     if args.trace:
         path = args.trace
     else:
         path, host_path = capture(args.model, args.steps, args.out, args.batch)
+        combined = merge_traces(path, host_path, args.out)
+    if args.journal:
+        from jumbo_mae_tpu_tpu.obs.journal import RunJournal, journal_dir
+
+        loc = journal_dir(args.journal)
+        jdir = loc if loc is not None and loc.is_dir() else args.journal
+        with RunJournal(jdir) as j:
+            j.event(
+                "profile",
+                model=args.model,
+                steps=args.steps,
+                device_trace=path,
+                host_spans=host_path,
+                combined_trace=combined,
+            )
     by_cat, top_ops, top_src, top_tf = aggregate(path, args.steps)
     total = sum(by_cat.values())
     print(f"\ndevice time by hlo_category (ms/step, {args.steps} steps):")
@@ -193,6 +264,8 @@ def main(argv: list[str] | None = None) -> int:
     print(f"\ntrace: {path}")
     if host_path:
         print(f"host spans (chrome-trace, same format as run.chrome_trace): {host_path}")
+    if combined:
+        print(f"combined device+host timeline: {combined}")
     return 0
 
 

@@ -256,6 +256,8 @@ def diagnose(events: list[dict], flight: dict | None = None) -> str:
             "rollback",
             "quarantine",
             "flight_record",
+            "profile",
+            "compiled_program",
             "shutdown",
         )
     ]
@@ -282,6 +284,13 @@ def diagnose(events: list[dict], flight: dict | None = None) -> str:
             detail = f"{e.get('reason')} at step {e.get('step')}"
         elif etype == "run_start":
             detail = f"start_step {e.get('start_step', 0)}"
+        elif etype == "profile":
+            detail = f"trace capture → {e.get('combined_trace') or e.get('device_trace')}"
+        elif etype == "compiled_program":
+            detail = (
+                f"{e.get('program')}: {_fmt_num(e.get('flops', 0))} flops, "
+                f"{_fmt_num(e.get('bytes_accessed', 0))} bytes"
+            )
         lines.append(f"- +{dt:8.1f}s  `{etype}`  {detail}")
     lines.append("")
     if flights and not flight:
