@@ -83,6 +83,58 @@ def build_parser() -> argparse.ArgumentParser:
         help="submit images one-by-one through the micro-batching queue",
     )
     p.add_argument(
+        "--replicas",
+        type=int,
+        default=0,
+        metavar="N",
+        help="--serve: run N supervised engine replicas behind the queue "
+        "(crash-isolated request retry, restart with capped backoff, "
+        "quorum circuit breaker in /healthz); 0 = the single-engine "
+        "micro-batcher",
+    )
+    p.add_argument(
+        "--interarrival-ms",
+        type=float,
+        default=0.0,
+        metavar="MS",
+        help="--serve: pace submits MS apart instead of firing them all at "
+        "once (steady offered load for chaos and canary runs)",
+    )
+    p.add_argument(
+        "--swap-watch",
+        default="",
+        metavar="DIR",
+        help="--replicas: poll DIR for newly appearing checkpoint files or "
+        "dirs and run each through the parity- and canary-gated weight "
+        "hot-swap (promote on pass, automatic rollback on breach)",
+    )
+    p.add_argument(
+        "--swap-poll-s",
+        type=float,
+        default=0.5,
+        help="--swap-watch poll interval in seconds",
+    )
+    p.add_argument(
+        "--swap-parity-min",
+        type=float,
+        default=0.98,
+        help="hot-swap parity gate: min feature cosine of the candidate "
+        "weights vs the live weights on the probe batch",
+    )
+    p.add_argument(
+        "--swap-canary-requests",
+        type=int,
+        default=8,
+        help="hot-swap canary window: live requests the flipped replica "
+        "must serve before promotion",
+    )
+    p.add_argument(
+        "--swap-canary-timeout-s",
+        type=float,
+        default=10.0,
+        help="hot-swap canary window wall-clock bound",
+    )
+    p.add_argument(
         "--warmup",
         action="store_true",
         help="pre-compile every (task, bucket) executable before the first "
@@ -203,31 +255,41 @@ def main(argv: list[str] | None = None) -> Path | None:
         telemetry = TelemetryServer(health=health, port=args.metrics_port).start()
         print(f"[predict] exporter on :{telemetry.port} (/metrics, /healthz)")
 
-    engine = InferenceEngine(
-        cfg,
-        ckpt=args.ckpt,
-        dtype=args.dtype,
-        max_batch=args.max_batch,
-        quant=args.quant,
-        warm_cache=(
-            False if args.no_warmcache
-            else args.warmcache if args.warmcache is not None
-            else True
-        ),
-        encoder_cache=args.encoder_cache,
-    )
+    replicated = bool(args.serve and args.replicas > 0)
+    # restarts and promoted swaps read the checkpoint through this cell,
+    # so a replica rebuilt after a promote comes up on the new weights
+    ckpt_ref = {"ckpt": args.ckpt}
+
+    def make_engine():
+        return InferenceEngine(
+            cfg,
+            ckpt=ckpt_ref["ckpt"],
+            dtype=args.dtype,
+            max_batch=args.max_batch,
+            quant=args.quant,
+            warm_cache=(
+                False if args.no_warmcache
+                else args.warmcache if args.warmcache is not None
+                else True
+            ),
+            encoder_cache=args.encoder_cache,
+        )
+
     if args.ckpt == "":
         print("[predict] WARNING: no --ckpt — serving a random init")
-    if engine.warmcache is not None:
-        print(f"[predict] warmcache: {engine.warmcache.root}")
-    if args.warmup:
-        n_compiles = engine.warmup((args.task,), pool=args.pool)
-        hits = sum(engine.warm_hits.values())
-        print(
-            f"[predict] warmup: {n_compiles} executable(s) compiled, "
-            f"{hits} loaded from warmcache"
-        )
-    if health is not None:
+    engine = None
+    if not replicated:
+        engine = make_engine()
+        if engine.warmcache is not None:
+            print(f"[predict] warmcache: {engine.warmcache.root}")
+        if args.warmup:
+            n_compiles = engine.warmup((args.task,), pool=args.pool)
+            hits = sum(engine.warm_hits.values())
+            print(
+                f"[predict] warmup: {n_compiles} executable(s) compiled, "
+                f"{hits} loaded from warmcache"
+            )
+    if health is not None and not replicated:
         health.set_ready(
             True, detail=f"engine up (ckpt={'yes' if args.ckpt else 'random'})"
         )
@@ -270,7 +332,8 @@ def main(argv: list[str] | None = None) -> Path | None:
         if access is not None or slo_tracker is not None or telemetry is not None:
             tracer = RequestTracer(
                 access_log=access,
-                breakdown=engine.last_breakdown,
+                # replicated: each flush passes its own engine's breakdown
+                breakdown=engine.last_breakdown if engine is not None else None,
                 on_finish=(
                     slo_tracker.observe_trace if slo_tracker is not None else None
                 ),
@@ -281,6 +344,57 @@ def main(argv: list[str] | None = None) -> Path | None:
                 health.probe("slo", slo_tracker.healthz_info)
             if telemetry is not None:
                 telemetry.add_pre_scrape(slo_tracker.evaluate)
+
+    rs = None
+    swap_ctl = None
+    if replicated:
+        from jumbo_mae_tpu_tpu.infer import ReplicaSet, WeightSwapController
+
+        def engine_provider(idx):
+            eng = make_engine()
+            if args.warmup:
+                eng.warmup((args.task,), pool=args.pool)
+            return eng
+
+        def run_replica(eng, batch, metas):
+            return eng.predict(batch, task=args.task, **kw)
+
+        rs = ReplicaSet(
+            engine_provider,
+            run_replica,
+            replicas=args.replicas,
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            max_queue=args.max_queue,
+            tracer=tracer,
+            task=args.task,
+            health=health,
+            breakdown=lambda eng: eng.last_breakdown(),
+        )
+        eng0 = rs.replica(0).engine
+        if eng0.warmcache is not None:
+            print(f"[predict] warmcache: {eng0.warmcache.root}")
+        print(
+            f"[predict] replica pool: {args.replicas} replicas, "
+            f"quorum {rs.quorum}"
+        )
+        if health is not None:
+            health.set_ready(True, detail=f"pool up ({args.replicas} replicas)")
+            if slo_tracker is not None:
+                health.degraded_when(
+                    lambda: slo_tracker.degraded() or rs.degraded()
+                )
+            else:
+                health.degraded_when(rs.degraded)
+        if args.swap_watch:
+            swap_ctl = WeightSwapController(
+                rs,
+                parity_min_cosine=args.swap_parity_min,
+                canary_requests=args.swap_canary_requests,
+                canary_timeout_s=args.swap_canary_timeout_s,
+                on_promote=lambda c: ckpt_ref.__setitem__("ckpt", c),
+            )
+        engine = eng0  # image geometry below; requests go through the pool
 
     size = engine.image_size
     if args.synthetic:
@@ -310,7 +424,105 @@ def main(argv: list[str] | None = None) -> Path | None:
     kw = {"pool": args.pool} if args.task == "features" else (
         {"seed": args.seed} if args.task == "reconstruct" else {}
     )
-    if args.serve:
+    if args.serve and rs is not None:
+        import threading
+        import time as _time
+
+        if slo_tracker is not None:
+            slo_tracker.add_probe(
+                "queue_depth", lambda: rs.stats()["queue_depth"]
+            )
+            slo_tracker.add_probe(
+                "healthy_replicas", lambda: rs.stats()["healthy"]
+            )
+        swap_stop = threading.Event()
+        swap_thread = None
+        if swap_ctl is not None:
+            watch_root = Path(args.swap_watch)
+            watch_root.mkdir(parents=True, exist_ok=True)
+
+            def _watch_swaps():
+                # entries present at startup are the baseline, not pushes;
+                # push checkpoints by atomic rename so a partial write
+                # never gets picked up
+                seen = {p.name for p in watch_root.iterdir()}
+                while True:
+                    stopping = swap_stop.is_set()
+                    for p in sorted(watch_root.iterdir()):
+                        if p.name in seen or p.name.startswith("."):
+                            continue
+                        seen.add(p.name)
+                        print(f"[predict] swap-watch: new checkpoint {p}")
+                        rep = swap_ctl.swap(str(p))
+                        msg = (
+                            f"[predict] swap {p.name}: "
+                            f"verdict={rep['verdict']} stage={rep['stage']}"
+                        )
+                        if rep.get("parity"):
+                            msg += (
+                                f" cosine_min="
+                                f"{rep['parity']['cosine_min']:.4f}"
+                            )
+                        print(msg)
+                    if stopping:
+                        return  # one final sweep ran after stop was set
+                    swap_stop.wait(args.swap_poll_s)
+
+            swap_thread = threading.Thread(target=_watch_swaps, daemon=True)
+            swap_thread.start()
+            print(
+                f"[predict] swap-watch: polling {watch_root} "
+                f"every {args.swap_poll_s:g}s"
+            )
+        futs = []
+        for img in images:
+            futs.append(rs.submit(img, deadline_ms=args.deadline_ms))
+            if args.interarrival_ms > 0:
+                _time.sleep(args.interarrival_ms / 1000.0)
+        rows, failed = [], 0
+        for f in futs:
+            try:
+                rows.append(f.result())
+            except Exception as e:  # noqa: BLE001 — typed failures are tallied, not fatal
+                failed += 1
+                rows.append(None)
+                print(f"[predict] request failed: {type(e).__name__}: {e}")
+        print(
+            f"[predict] pool served {len(rows) - failed}/{len(rows)} ok "
+            f"({failed} failed)"
+        )
+        if swap_thread is not None:
+            swap_stop.set()
+            swap_thread.join(timeout=args.swap_canary_timeout_s + 60.0)
+        st = rs.stats()
+        print(f"[predict] replicas: {json.dumps(st['replicas'])}")
+        rs.close()
+        kept = [(n, r) for n, r in zip(names, rows) if r is not None]
+        if not kept:
+            raise SystemExit("[predict] every request failed")
+        names = [n for n, _ in kept]
+        rows = [r for _, r in kept]
+        out = (
+            {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+            if isinstance(rows[0], dict)
+            else np.stack(rows)
+        )
+        if slo_tracker is not None:
+            rep = slo_tracker.evaluate()
+            objs = "; ".join(
+                f"{o['name']}: value={o['value']:g} "
+                f"burn={o['burn_slow']:g} breached={o['breached']}"
+                for o in rep["objectives"]
+            )
+            print(
+                f"[predict] SLO verdict: degraded={rep['degraded']} "
+                f"shed_rate={rep['shed_rate']:g} — {objs}"
+            )
+            if tracer is not None:
+                tracer.event("slo_summary", report=rep)
+        if tracer is not None:
+            tracer.close()
+    elif args.serve:
         def run_fn(batch):
             if health is not None:
                 health.beat("infer_batch")

@@ -32,10 +32,22 @@ All selectors of a rule must match for it to fire. Examples::
     data.shard_open:raise@key~shard-0003          # one shard always fails
     serve.submit:delay(0.05)@n%10=0               # every 10th submit is slow
     seed=7;data.decode:corrupt(4)@p=0.01          # 1% of decodes corrupted
+    serve.replica:raise(RuntimeError)@key~r1,n<1  # crash replica r1's first batch
+    serve.replica:delay(5.0)@key~r2               # wedge replica r2 (hang path)
+    ckpt.load:corrupt(4)                          # diverge a hot-swap restore
 
 Known sites (free-form names are allowed; these are the wired ones):
 ``data.shard_open``, ``data.decode``, ``train.loss``, ``train.grad``,
-``serve.submit``, ``ckpt.save``.
+``serve.submit``, ``serve.replica``, ``ckpt.save``, ``ckpt.load``.
+
+``serve.replica`` fires at the top of each replica's batched predict with
+``key`` = the replica name (``r0``, ``r1``, …), so ``key~`` targets one
+replica: ``raise`` is a crash, ``delay`` past the supervisor's hang timeout
+is a hang. ``ckpt.load`` fires on the weight-swap restore path with the
+restored params tree as ``data`` — ``corrupt(k)`` sign-flips ``k``
+deterministically-chosen leaves so the parity gate sees a diverged model
+(a real bad-push, not a parse error), while ``raise`` models an unreadable
+checkpoint.
 """
 
 from __future__ import annotations
@@ -234,19 +246,42 @@ class FaultPlan:
 
 
 def _corrupt_bytes(data, nbytes: int, seed: int, salt: int):
-    """Flip ``nbytes`` deterministically-chosen bytes of a bytes payload
-    (non-bytes data is returned untouched — corrupt only makes sense for
-    byte streams like tar members / image payloads)."""
-    if not isinstance(data, (bytes, bytearray)) or len(data) == 0:
-        return data
+    """Corrupt a payload deterministically. Bytes payloads (tar members,
+    image blobs) get ``nbytes`` flipped bytes; dict payloads (a restored
+    params tree at ``ckpt.load``) get ``nbytes`` leaves sign-flipped and
+    rescaled — numerically plausible, parity-detectably wrong. Anything
+    else is returned untouched."""
     import random
 
-    rng = random.Random((seed, salt, len(data)))
-    buf = bytearray(data)
-    for _ in range(min(nbytes, len(buf))):
-        i = rng.randrange(len(buf))
-        buf[i] ^= 0xFF
-    return bytes(buf)
+    if isinstance(data, (bytes, bytearray)):
+        if len(data) == 0:
+            return data
+        rng = random.Random((seed, salt, len(data)))
+        buf = bytearray(data)
+        for _ in range(min(nbytes, len(buf))):
+            i = rng.randrange(len(buf))
+            buf[i] ^= 0xFF
+        return bytes(buf)
+    if isinstance(data, dict) and data:
+        import numpy as np
+        from jax import tree_util
+
+        leaves, treedef = tree_util.tree_flatten(data)
+        idx = [
+            i
+            for i, leaf in enumerate(leaves)
+            if hasattr(leaf, "shape") and getattr(leaf, "size", 0)
+        ]
+        if not idx:
+            return data
+        rng = random.Random((seed, salt, len(idx)))
+        chosen = rng.sample(idx, min(nbytes, len(idx)))
+        out = list(leaves)
+        for i in chosen:
+            arr = np.asarray(out[i])
+            out[i] = (-3.0 * arr - 0.5).astype(arr.dtype)
+        return tree_util.tree_unflatten(treedef, out)
+    return data
 
 
 # ---------------------------------------------------------------- installers

@@ -14,6 +14,12 @@ from jumbo_mae_tpu_tpu.infer.quant import (
     parity_report,
     quantize_params,
 )
+from jumbo_mae_tpu_tpu.infer.replicaset import (
+    PoolUnhealthyError,
+    ReplicaSet,
+    RetriesExhaustedError,
+    WeightSwapController,
+)
 from jumbo_mae_tpu_tpu.infer.warmcache import WarmCache
 
 __all__ = [
@@ -21,10 +27,14 @@ __all__ = [
     "InferenceEngine",
     "MicroBatcher",
     "OversizedBatchError",
+    "PoolUnhealthyError",
     "QuantizedTensor",
     "QueueFullError",
+    "ReplicaSet",
+    "RetriesExhaustedError",
     "ShutdownError",
     "WarmCache",
+    "WeightSwapController",
     "bucket_for",
     "parity_report",
     "quantize_params",

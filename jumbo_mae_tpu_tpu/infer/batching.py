@@ -25,13 +25,17 @@ against a full queue fails fast with :class:`QueueFullError` (shed load —
 an unbounded queue turns overload into unbounded latency for everyone);
 a per-request ``deadline_ms`` expires queued requests with
 :class:`DeadlineExceededError` at batch-admission time instead of letting a
-stale request occupy a batch slot; and :meth:`close` resolves every pending
-future with :class:`ShutdownError` — a ``submit()`` caller can never block
-forever on a batcher that is shutting down.
+stale request occupy a batch slot — and is enforced *again* at resolution:
+a request whose deadline passed while it waited for co-travelers or inside
+``run_fn`` is failed with the same error (access-log outcome ``late``,
+``infer_requests_late_total``) rather than resolving ``ok`` after the
+caller gave up; and :meth:`close` resolves every pending future with
+:class:`ShutdownError` — a ``submit()`` caller can never block forever on
+a batcher that is shutting down.
 
 With a :class:`~jumbo_mae_tpu_tpu.obs.reqtrace.RequestTracer` attached,
 every request carries a trace context from the first line of ``submit()``
-to its terminal outcome (``ok|shed|deadline|aborted|shutdown``) — per-
+to its terminal outcome (``ok|shed|deadline|late|aborted|shutdown``) — per-
 request queue wait, coalescing wait, compute/fetch split, batch/bucket/pad
 — into ``request_*`` histograms and the JSONL access log. The trace begins
 *before* the ``serve.submit`` fault point so injected submit stalls show up
@@ -62,8 +66,9 @@ class QueueFullError(RuntimeError):
 
 
 class DeadlineExceededError(TimeoutError):
-    """Set on a request future whose ``deadline_ms`` passed before the
-    collector could admit it to a batch."""
+    """Set on a request future whose ``deadline_ms`` passed — either before
+    the collector could admit it to a batch (outcome ``deadline``) or after
+    admission, during coalescing/compute (outcome ``late``)."""
 
 
 class ShutdownError(RuntimeError):
@@ -143,6 +148,11 @@ class MicroBatcher:
         self._m_expired = reg.counter(
             "infer_deadline_exceeded_total",
             "requests expired past their deadline before batch admission",
+        )
+        self._m_late = reg.counter(
+            "infer_requests_late_total",
+            "requests whose deadline passed after admission (during "
+            "coalescing or compute) — failed at resolution, not resolved ok",
         )
         self._m_aborted = reg.counter(
             "infer_requests_aborted_total",
@@ -385,11 +395,26 @@ class MicroBatcher:
         # one lock hand-off for the whole batch's latencies, before the
         # waiters wake (their submit→result time must not include it)
         self._m_latency.observe_many([done - it[2] for it in batch])
-        for tr in traces:
-            self._tracer.finish(tr, "ok")
         if isinstance(out, dict):
-            for i, it in enumerate(batch):
-                it[1].set_result({k: v[i] for k, v in out.items()})
+            rows = [{k: v[i] for k, v in out.items()} for i in range(len(batch))]
         else:
-            for it, row in zip(batch, out):
+            rows = out
+        # deadline is re-checked at resolution: admission alone let a
+        # request blow its budget inside the coalescing wait or run_fn and
+        # still resolve ok — the caller had already given up on it
+        now_mono = time.monotonic()
+        for it, row in zip(batch, rows):
+            dl = it[3]
+            if dl is not None and now_mono > dl:
+                self._m_late.inc()
+                if it[4] is not None:
+                    self._tracer.finish(it[4], "late")
+                it[1].set_exception(
+                    DeadlineExceededError(
+                        "request deadline passed during batch coalescing/compute"
+                    )
+                )
+            else:
+                if it[4] is not None:
+                    self._tracer.finish(it[4], "ok")
                 it[1].set_result(row)

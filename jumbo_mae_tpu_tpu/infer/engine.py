@@ -503,6 +503,68 @@ class InferenceEngine:
                     self._tasks[task] = t
         return t
 
+    # ------------------------------------------------------------ hot swap
+
+    def swap_weights(self, params, batch_stats=None, *, ckpt: str = "") -> dict:
+        """Replace the live weights with a newly restored tree — zero
+        compiles. Params/batch_stats are executable *arguments*, so every
+        cached AOT executable serves the new weights unchanged; only the
+        task variable trees are rebuilt (fresh init + graft + quant).
+
+        Returns an opaque snapshot of the previous weights for
+        :meth:`restore_snapshot` — the double buffer a hot-swap rollback
+        needs. Raises (leaving the previous weights live) when the new tree
+        does not graft onto this architecture; the swap controller treats
+        that as a failed swap. In-flight predicts are per-request atomic:
+        each dispatch reads one task dict, so a request serves entirely old
+        or entirely new weights, never a mix.
+        """
+        new_tree = _to_state_dict(params)
+        new_stats = (
+            _to_state_dict(batch_stats) if batch_stats is not None else None
+        )
+        with self._lock:
+            snap = {
+                "ckpt": self._ckpt,
+                "tree": self._ckpt_tree,
+                "stats": self._ckpt_stats,
+                "tasks": dict(self._tasks),
+            }
+            built = sorted(self._tasks)
+            self._ckpt = str(ckpt)
+            self._ckpt_tree = new_tree
+            self._ckpt_stats = new_stats
+        try:
+            rebuilt = {task: self._build_task(task) for task in built}
+        except BaseException:
+            with self._lock:
+                self._ckpt = snap["ckpt"]
+                self._ckpt_tree = snap["tree"]
+                self._ckpt_stats = snap["stats"]
+            raise
+        with self._lock:
+            self._tasks.update(rebuilt)
+        with self._enc_cache_lock:
+            # cached encoder outputs are weight-dependent
+            self._enc_cache.clear()
+        return snap
+
+    def restore_snapshot(self, snap: dict) -> None:
+        """Reinstate a :meth:`swap_weights` snapshot (rollback). Tasks
+        first built *after* the swap are dropped so they lazily rebuild
+        from the restored tree instead of keeping the rolled-back weights."""
+        with self._lock:
+            self._ckpt = snap["ckpt"]
+            self._ckpt_tree = snap["tree"]
+            self._ckpt_stats = snap["stats"]
+            for task in list(self._tasks):
+                if task in snap["tasks"]:
+                    self._tasks[task] = snap["tasks"][task]
+                else:
+                    del self._tasks[task]
+        with self._enc_cache_lock:
+            self._enc_cache.clear()
+
     # ---------------------------------------------------- executable cache
 
     def _task_key(self, task: str, pool: str | None) -> str:

@@ -1,0 +1,1160 @@
+"""Replicated serving tier: a supervised pool of inference engine workers.
+
+One ``InferenceEngine`` behind one queue means one wedged predict or one
+bad checkpoint load takes the whole serving path down. The
+:class:`ReplicaSet` turns that single point of failure into a supervised
+pool: N replicas, each an engine plus its own micro-batching worker thread
+(same ``max_batch``/``max_delay_ms``/``max_queue``/deadline semantics as
+:class:`~jumbo_mae_tpu_tpu.infer.batching.MicroBatcher`), behind a router
+that assigns each request to the least-loaded healthy replica.
+
+**Crash isolation.** A replica whose predict raises (or is fault-injected
+via the ``serve.replica`` site — ``key`` is the replica name) is marked
+down; its in-flight and queued requests are *requeued onto surviving
+replicas* with the failed replica in the request's excluded set, so a
+retry can never land back on the replica that just failed it. A replica
+whose predict hangs past ``hang_timeout_s`` is declared hung by the
+supervisor, its slot replaced, and its requests requeued the same way —
+the zombie thread's eventual late result loses the per-request settle
+race, so **every future still resolves exactly once** (ok / ok-with-retry
+attribution / typed error), and every resolution writes exactly one
+access-log row carrying ``replica``/``retries``/``requeued_from``.
+
+**Self-healing.** The supervisor restarts down replicas with capped
+exponential backoff (engine construction goes back through the provider,
+so a warm cache makes the restart compile-free), beats per-replica
+heartbeats into an attached :class:`~jumbo_mae_tpu_tpu.obs.exporter.
+HealthState`, and opens a circuit breaker when healthy replicas drop
+below ``quorum`` — surfaced as the *soft* degraded flag in ``/healthz``
+(the pool still serves whatever capacity survives; degraded must not
+flip the 503 or an autoscaler would amplify the outage).
+
+**Zero-downtime weight hot-swap.** The :class:`WeightSwapController`
+double-buffer-restores a new checkpoint (``restore_inference_state``; the
+``ckpt.load`` fault site fires here with the restored tree as payload),
+then promotes it through three gates, rolling back to the previous
+weights at the first failure:
+
+1. **parity** — the canary replica is paused, drained, and flipped via
+   ``InferenceEngine.swap_weights`` (zero compiles: params are executable
+   arguments); feature cosine vs the live weights' outputs on a fixed
+   probe batch (the ``infer/quant.py`` parity machinery) must clear
+   ``parity_min_cosine``. A corrupt or wrong-architecture push dies here
+   without ever serving traffic.
+2. **canary** — the flipped replica rejoins the pool and serves live
+   traffic; a dedicated ``obs/slo.py`` burn-rate tracker watches only its
+   outcomes for ``canary_requests`` requests (bounded by
+   ``canary_timeout_s``). A breach — or the canary crashing outright —
+   rolls the replica back to the buffered previous weights.
+3. **promote** — surviving replicas are flipped one at a time
+   (pause → drain → swap → resume), so the pool never stops serving; the
+   provider is then repointed so future restarts build the new weights.
+
+``serve_replica_*`` / ``serve_swap_*`` metrics and ``replica_*`` /
+``swap_*`` access-log events make every transition observable offline
+(``tools/serve_doctor.py``) and live (``/metrics``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable
+
+import numpy as np
+
+from jumbo_mae_tpu_tpu.faults.inject import fault_point
+from jumbo_mae_tpu_tpu.infer.batching import (
+    DeadlineExceededError,
+    QueueFullError,
+    ShutdownError,
+)
+from jumbo_mae_tpu_tpu.obs.metrics import NULL_REGISTRY, get_registry
+
+_STOP = object()
+
+
+class PoolUnhealthyError(RuntimeError):
+    """No healthy replica can take (or retry) a request — the pool is at
+    or below zero routable capacity for this request's excluded set."""
+
+
+class RetriesExhaustedError(RuntimeError):
+    """A request was requeued off failing replicas more than
+    ``max_retries`` times; the last replica error is in the message."""
+
+
+class _Request:
+    """One routed request: the payload, its future, and the settle latch
+    that makes resolution exactly-once under requeue/zombie races."""
+
+    __slots__ = (
+        "image", "meta", "deadline", "fut", "tr", "excluded",
+        "retries", "t0", "_settled", "_lock",
+    )
+
+    def __init__(self, image, meta, deadline, fut, tr):
+        self.image = image
+        self.meta = meta
+        self.deadline = deadline
+        self.fut = fut
+        self.tr = tr
+        self.excluded: set[str] = set()
+        self.retries = 0
+        self.t0 = time.perf_counter()
+        self._settled = False
+        self._lock = threading.Lock()
+
+    def settle(self) -> bool:
+        """Claim the exclusive right to resolve this request. Exactly one
+        caller ever wins — the requeue path, a surviving replica, a zombie
+        (hung-then-woken) replica, and the close() sweep all race through
+        here."""
+        with self._lock:
+            if self._settled:
+                return False
+            self._settled = True
+            return True
+
+    @property
+    def settled(self) -> bool:
+        return self._settled
+
+
+class _Replica:
+    """One pool slot incarnation: an engine, an inbound queue, a worker
+    thread, and the supervisor-visible state."""
+
+    __slots__ = (
+        "idx", "name", "gen", "engine", "q", "thread", "state",
+        "busy_since", "pending", "served",
+    )
+
+    def __init__(self, idx: int, gen: int, engine):
+        self.idx = idx
+        self.name = f"r{idx}"
+        self.gen = gen
+        self.engine = engine
+        self.q: queue.SimpleQueue = queue.SimpleQueue()
+        self.thread: threading.Thread | None = None
+        self.state = "up"          # up | paused | down
+        self.busy_since: float | None = None
+        self.pending: tuple = ()   # records in the in-flight batch
+        self.served = 0
+
+
+class ReplicaSet:
+    """Supervised pool of N engine workers with MicroBatcher semantics.
+
+    ``engine_provider(idx)`` builds replica ``idx``'s engine — called at
+    construction and again on every restart (route it through a warm
+    cache and restarts are compile-free). ``run(engine, batch, metas)``
+    is the batched predict. Both are plain callables so tests drive the
+    pool with stub engines and the CLI drives it with
+    :class:`InferenceEngine`.
+
+    Use as a context manager or call :meth:`close` — every pending future
+    is resolved within a bounded sweep even if a worker is wedged.
+    """
+
+    def __init__(
+        self,
+        engine_provider: Callable[[int], Any],
+        run: Callable[[Any, np.ndarray, list], Any],
+        *,
+        replicas: int = 2,
+        max_batch: int = 32,
+        max_delay_ms: float = 5.0,
+        max_queue: int | None = None,
+        max_retries: int = 2,
+        hang_timeout_s: float = 30.0,
+        restart_backoff_s: float = 0.25,
+        restart_backoff_max_s: float = 8.0,
+        quorum: int | None = None,
+        supervise_interval_s: float = 0.05,
+        tracer=None,
+        task: str = "",
+        registry=None,
+        health=None,
+        breakdown: Callable[[Any], dict | None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if quorum is not None and not 1 <= quorum <= replicas:
+            raise ValueError(f"quorum must be in [1, {replicas}], got {quorum}")
+        self._provider = engine_provider
+        self._run = run
+        self.n = int(replicas)
+        self.max_batch = int(max_batch)
+        self.max_delay = float(max_delay_ms) / 1000.0
+        self.max_queue = max_queue
+        self.max_retries = int(max_retries)
+        self.hang_timeout_s = float(hang_timeout_s)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.restart_backoff_max_s = float(restart_backoff_max_s)
+        # default quorum: majority — the smallest pool that can still
+        # claim it is "the" serving tier rather than a stray survivor
+        self.quorum = quorum if quorum is not None else self.n // 2 + 1
+        self._interval = float(supervise_interval_s)
+        self._tracer = tracer
+        self.task = task
+        self._health = health
+        self._breakdown = breakdown
+        self._clock = clock
+        self._observers: list[Callable] = []
+
+        reg = registry if registry is not None else get_registry()
+        # pool-tier metrics (serve_replica_*) ...
+        self._m_up = reg.gauge(
+            "serve_replica_up", "replica is up and routable (1) or not (0)",
+            labels=("replica",),
+        )
+        self._m_restarts = reg.counter(
+            "serve_replica_restarts_total",
+            "replica restarts completed by the supervisor",
+            labels=("replica",),
+        )
+        self._m_crashes = reg.counter(
+            "serve_replica_crashes_total",
+            "replica predict failures by kind (crash|hang|restart_error)",
+            labels=("replica", "kind"),
+        )
+        self._m_served = reg.counter(
+            "serve_replica_requests_total",
+            "requests resolved ok, by serving replica",
+            labels=("replica",),
+        )
+        self._m_requeued = reg.counter(
+            "serve_replica_requeued_total",
+            "in-flight/queued requests requeued off a failed replica, "
+            "attributed to the replica that failed them",
+            labels=("replica",),
+        )
+        self._m_healthy = reg.gauge(
+            "serve_replica_healthy_count", "replicas currently up or paused"
+        )
+        self._m_quorum = reg.gauge(
+            "serve_replica_quorum", "healthy-replica floor for the breaker"
+        )
+        self._m_breaker = reg.gauge(
+            "serve_replica_breaker_open",
+            "1 while healthy replicas < quorum (degraded in /healthz)",
+        )
+        self._m_breaker_trips = reg.counter(
+            "serve_replica_breaker_trips_total",
+            "times the pool dropped below quorum",
+        )
+        # ... and the same request-tier families MicroBatcher publishes,
+        # so existing dashboards/doctors read the replicated tier unchanged
+        self._m_latency = reg.histogram(
+            "infer_request_latency_seconds",
+            "request latency: submit() to resolved future",
+        )
+        self._m_requests = reg.counter(
+            "infer_requests_total", "requests collected into batches"
+        )
+        self._m_batches = reg.counter(
+            "infer_batches_total", "batches flushed through run_fn"
+        )
+        self._m_shed = reg.counter(
+            "infer_requests_shed_total",
+            "submits rejected with QueueFullError (queue at max_queue)",
+        )
+        self._m_expired = reg.counter(
+            "infer_deadline_exceeded_total",
+            "requests expired past their deadline before batch admission",
+        )
+        self._m_late = reg.counter(
+            "infer_requests_late_total",
+            "requests whose deadline passed after admission (during "
+            "coalescing or compute) — failed at resolution, not resolved ok",
+        )
+        self._m_aborted = reg.counter(
+            "infer_requests_aborted_total",
+            "pending requests failed by close()",
+        )
+        self._m_quorum.set(self.quorum)
+
+        self._depth = 0
+        self._submitted = 0
+        self._shed_n = 0
+        self._depth_lock = threading.Lock()
+        self._live: set[_Request] = set()
+        self._live_lock = threading.Lock()
+        self._closed = False
+        self._drain = True
+        self._breaker_open = False
+        self._canary_pref: str | None = None
+        self._state_lock = threading.Lock()
+
+        self._slots: list[_Replica] = []
+        self._fails = [0] * self.n
+        self._restart_at = [0.0] * self.n
+        self._restarting = [False] * self.n
+        for idx in range(self.n):
+            rep = _Replica(idx, gen=0, engine=self._provider(idx))
+            self._slots.append(rep)
+            self._start_worker(rep)
+            self._m_up.labels(rep.name).set(1)
+            if self._health is not None:
+                self._health.beat(f"replica.{rep.name}")
+        self._update_health()
+        if self._health is not None:
+            self._health.probe("replicas", self.stats)
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True, name="replicaset-supervisor"
+        )
+        self._supervisor.start()
+
+    # ------------------------------------------------------------- client
+
+    def submit(
+        self,
+        image: np.ndarray,
+        *,
+        deadline_ms: float | None = None,
+        meta=None,
+    ) -> Future:
+        """Route one request to a healthy replica; returns a future for
+        its row of the batched result. Shed/deadline/shutdown semantics
+        match :meth:`MicroBatcher.submit`; additionally raises
+        :class:`PoolUnhealthyError` when no replica is routable."""
+        tr = (
+            self._tracer.begin(task=self.task, deadline_ms=deadline_ms)
+            if self._tracer is not None
+            else None
+        )
+        try:
+            fault_point("serve.submit")
+            if self._closed:
+                raise ShutdownError("ReplicaSet is closed")
+            with self._depth_lock:
+                self._submitted += 1
+                if self.max_queue is not None and self._depth >= self.max_queue:
+                    self._m_shed.inc()
+                    self._shed_n += 1
+                    raise QueueFullError(
+                        f"request queue full ({self._depth}/{self.max_queue})"
+                    )
+                self._depth += 1
+            target = self._pick(frozenset())
+            if target is None:
+                with self._depth_lock:
+                    self._depth -= 1
+                raise PoolUnhealthyError(
+                    f"no healthy replica (healthy={self._healthy_count()}, "
+                    f"quorum={self.quorum})"
+                )
+        except BaseException as e:  # noqa: BLE001 — classify, trace, re-raise
+            if tr is not None:
+                if isinstance(e, QueueFullError):
+                    self._tracer.finish(tr, "shed")
+                elif isinstance(e, ShutdownError) or self._closed:
+                    self._tracer.finish(tr, "shutdown")
+                else:
+                    self._tracer.finish(
+                        tr, "aborted", error=f"{type(e).__name__}: {e}"
+                    )
+            raise
+        fut: Future = Future()
+        if tr is not None:
+            fut.rid = tr.rid
+        deadline = (
+            None
+            if deadline_ms is None
+            else time.monotonic() + float(deadline_ms) / 1000.0
+        )
+        rec = _Request(np.asarray(image), meta, deadline, fut, tr)
+        with self._live_lock:
+            self._live.add(rec)
+        target.q.put(rec)
+        return rec.fut
+
+    def __call__(self, image, *, deadline_ms: float | None = None):
+        return self.submit(image, deadline_ms=deadline_ms).result()
+
+    def add_observer(self, fn: Callable) -> None:
+        """``fn(replica_name, outcome, latency_s, retries)`` on every
+        resolved request — the canary SLO feed."""
+        self._observers.append(fn)
+
+    def remove_observer(self, fn: Callable) -> None:
+        try:
+            self._observers.remove(fn)
+        except ValueError:
+            pass
+
+    # ----------------------------------------------------------- lifecycle
+
+    def replica(self, idx: int) -> _Replica:
+        return self._slots[idx]
+
+    def generation(self, idx: int) -> int:
+        return self._slots[idx].gen
+
+    def first_routable(self) -> _Replica | None:
+        return self._pick(frozenset())
+
+    def _healthy_count(self) -> int:
+        return sum(1 for rep in self._slots if rep.state in ("up", "paused"))
+
+    def degraded(self) -> bool:
+        """Breaker state, shaped for :meth:`HealthState.degraded_when`."""
+        return self._breaker_open
+
+    def pause(self, idx: int) -> None:
+        """Take a replica out of routing (it drains what it already has);
+        the swap controller's flip window."""
+        with self._state_lock:
+            rep = self._slots[idx]
+            if rep.state == "up":
+                rep.state = "paused"
+
+    def resume(self, idx: int) -> None:
+        with self._state_lock:
+            rep = self._slots[idx]
+            if rep.state == "paused":
+                rep.state = "up"
+
+    def wait_idle(self, idx: int, timeout_s: float = 10.0) -> bool:
+        """Block until replica ``idx`` has nothing queued or in flight
+        (True) or the timeout passes (False)."""
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
+            rep = self._slots[idx]
+            if rep.q.empty() and rep.busy_since is None and not rep.pending:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def set_engine_provider(self, fn: Callable[[int], Any]) -> None:
+        """Repoint restarts at a new engine source (a promoted swap must
+        survive a later replica restart)."""
+        self._provider = fn
+
+    def stats(self) -> dict:
+        with self._depth_lock:
+            depth, submitted, shed = self._depth, self._submitted, self._shed_n
+        return {
+            "replicas": {
+                rep.name: {
+                    "state": rep.state,
+                    "gen": rep.gen,
+                    "queued": rep.q.qsize(),
+                    "served": rep.served,
+                    "restarts": self._fails[rep.idx],
+                }
+                for rep in self._slots
+            },
+            "healthy": self._healthy_count(),
+            "quorum": self.quorum,
+            "breaker_open": self._breaker_open,
+            "queue_depth": depth,
+            "requests_submitted": submitted,
+            "requests_shed": shed,
+        }
+
+    def close(self, drain: bool = True, timeout_s: float = 10.0):
+        """Stop everything and resolve EVERY pending request. Joins are
+        bounded — a hung worker cannot hang close(); its requests are
+        swept with :class:`ShutdownError` (the settle latch keeps a
+        late zombie result from double-resolving them)."""
+        if self._closed:
+            return
+        self._drain = drain
+        self._closed = True
+        self._supervisor.join(timeout=max(1.0, self._interval * 4))
+        for rep in self._slots:
+            rep.q.put(_STOP)
+        deadline = time.monotonic() + timeout_s
+        for rep in self._slots:
+            if rep.thread is not None:
+                rep.thread.join(timeout=max(0.0, deadline - time.monotonic()))
+        # sweep: anything still unresolved (queued behind a sentinel,
+        # stranded on a down slot, in a wedged worker) fails typed now
+        with self._live_lock:
+            leftovers = list(self._live)
+        for rec in leftovers:
+            if rec.settle():
+                self._m_aborted.inc()
+                self._finish(
+                    rec, "shutdown", exc=ShutdownError("ReplicaSet closed")
+                )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------- router
+
+    def _pick(self, excluded) -> _Replica | None:
+        best, best_sz = None, None
+        pref, pref_sz = None, None
+        for rep in self._slots:
+            if rep.state != "up" or rep.name in excluded:
+                continue
+            sz = rep.q.qsize()
+            if best is None or sz < best_sz:
+                best, best_sz = rep, sz
+            if rep.name == self._canary_pref:
+                pref, pref_sz = rep, sz
+        # a canary under evaluation takes ties: least-loaded tie-breaking
+        # would otherwise starve any slot but the first on an idle pool,
+        # and the canary window needs live traffic to judge
+        if pref is not None and pref_sz <= best_sz:
+            return pref
+        return best
+
+    def set_canary_preference(self, name: str | None) -> None:
+        """Route queue-size ties to this replica (the swap controller's
+        canary window); ``None`` restores pure least-loaded routing."""
+        self._canary_pref = name
+
+    def _event(self, etype: str, **fields) -> None:
+        if self._tracer is not None:
+            self._tracer.event(etype, **fields)
+
+    def _finish(
+        self, rec: _Request, outcome: str, *,
+        result=None, exc=None, replica: str | None = None,
+        error: str | None = None,
+    ) -> None:
+        """Resolve a settled record: trace row first, then the future —
+        callers that see the future done can rely on the row existing."""
+        with self._live_lock:
+            self._live.discard(rec)
+        if rec.tr is not None:
+            rec.tr.replica_id = replica
+            rec.tr.retries = rec.retries
+            if rec.excluded:
+                rec.tr.requeued_from = ",".join(sorted(rec.excluded))
+            self._tracer.finish(rec.tr, outcome, error=error)
+        if exc is not None:
+            rec.fut.set_exception(exc)
+        else:
+            rec.fut.set_result(result)
+        lat = time.perf_counter() - rec.t0
+        for fn in list(self._observers):
+            try:
+                fn(replica, outcome, lat, rec.retries)
+            except Exception:  # noqa: BLE001 — observers must not kill serving
+                pass
+
+    def _requeue(self, rec: _Request, from_rep: _Replica, err: str) -> None:
+        """Move one request off a failed replica: excluded-set + retry
+        budget + survivor routing; terminal failures settle typed."""
+        if rec.settled:
+            return
+        rec.excluded.add(from_rep.name)
+        rec.retries += 1
+        self._m_requeued.labels(from_rep.name).inc()
+        if self._closed and self._drain:
+            if rec.settle():
+                self._m_aborted.inc()
+                self._finish(
+                    rec, "shutdown", exc=ShutdownError("ReplicaSet closed")
+                )
+            return
+        if rec.retries > self.max_retries:
+            if rec.settle():
+                self._finish(
+                    rec, "aborted",
+                    exc=RetriesExhaustedError(
+                        f"retries exhausted after {rec.retries} attempts; "
+                        f"last error on {from_rep.name}: {err}"
+                    ),
+                    error=f"RetriesExhaustedError: last error on "
+                          f"{from_rep.name}: {err}",
+                )
+            return
+        target = self._pick(rec.excluded)
+        if target is None:
+            if rec.settle():
+                self._finish(
+                    rec, "aborted",
+                    exc=PoolUnhealthyError(
+                        f"no surviving replica outside {sorted(rec.excluded)} "
+                        f"to retry on (last error: {err})"
+                    ),
+                    error="PoolUnhealthyError: no surviving replica",
+                )
+            return
+        with self._depth_lock:
+            self._depth += 1
+        target.q.put(rec)
+
+    def _drain_slot(self, rep: _Replica, err: str) -> None:
+        """Requeue everything queued on a down slot."""
+        while True:
+            try:
+                item = rep.q.get_nowait()
+            except queue.Empty:
+                return
+            if item is _STOP:
+                continue
+            with self._depth_lock:
+                self._depth -= 1
+            self._requeue(item, rep, err)
+
+    # ------------------------------------------------------------- worker
+
+    def _start_worker(self, rep: _Replica) -> None:
+        rep.thread = threading.Thread(
+            target=self._worker, args=(rep,), daemon=True,
+            name=f"replica-{rep.name}-g{rep.gen}",
+        )
+        rep.thread.start()
+
+    def _stale(self, rep: _Replica) -> bool:
+        return self._slots[rep.idx] is not rep
+
+    def _worker(self, rep: _Replica) -> None:
+        while not self._stale(rep):
+            try:
+                item = rep.q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
+            if item is _STOP:
+                return
+            batch: list[_Request] = []
+            self._admit(item, batch)
+            coalesce_deadline = time.monotonic() + self.max_delay
+            stop = False
+            while len(batch) < self.max_batch:
+                remaining = coalesce_deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = rep.q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                self._admit(nxt, batch)
+            if batch and not self._flush(rep, batch):
+                return  # crashed: restart is the supervisor's job now
+            if stop:
+                return
+
+    def _admit(self, rec: _Request, batch: list) -> None:
+        with self._depth_lock:
+            self._depth -= 1
+        if rec.settled:
+            return  # already resolved elsewhere (requeue/zombie race)
+        if self._closed and self._drain:
+            if rec.settle():
+                self._m_aborted.inc()
+                self._finish(
+                    rec, "shutdown", exc=ShutdownError("ReplicaSet closed")
+                )
+            return
+        if rec.deadline is not None and time.monotonic() > rec.deadline:
+            if rec.settle():
+                self._m_expired.inc()
+                self._finish(
+                    rec, "deadline",
+                    exc=DeadlineExceededError(
+                        "request deadline passed while queued"
+                    ),
+                )
+            return
+        if rec.tr is not None:
+            self._tracer.admitted(rec.tr)
+        batch.append(rec)
+
+    def _flush(self, rep: _Replica, batch: list[_Request]) -> bool:
+        """Run one batch on this replica. Returns False when the replica
+        crashed (worker must exit)."""
+        self._m_batches.inc()
+        self._m_requests.inc(len(batch))
+        traces = [rec.tr for rec in batch if rec.tr is not None]
+        if traces:
+            self._tracer.flush_begin(traces)
+        # pending + busy_since go up BEFORE the fault point: an injected
+        # delay (the hang model) must be visible to the supervisor, and a
+        # hang's in-flight records must be findable for requeue
+        rep.pending = tuple(batch)
+        rep.busy_since = self._clock()
+        t_run = time.perf_counter()
+        try:
+            fault_point("serve.replica", key=rep.name)
+            stacked = np.stack([rec.image for rec in batch])
+            out = self._run(rep.engine, stacked, [rec.meta for rec in batch])
+        except BaseException as e:  # noqa: BLE001 — crash-isolate the replica
+            rep.busy_since = None
+            rep.pending = ()
+            self._on_failure(rep, batch, e, kind="crash")
+            return False
+        done = time.perf_counter()
+        rep.busy_since = None
+        rep.pending = ()
+        if traces:
+            bd = (
+                (lambda: self._breakdown(rep.engine))
+                if self._breakdown is not None
+                else None
+            )
+            self._tracer.flush_end(
+                traces, run_s=done - t_run, batch=len(batch), breakdown=bd
+            )
+        self._m_latency.observe_many([done - rec.t0 for rec in batch])
+        if isinstance(out, dict):
+            rows = [
+                {k: v[i] for k, v in out.items()} for i in range(len(batch))
+            ]
+        else:
+            rows = out
+        now_mono = time.monotonic()
+        for rec, row in zip(batch, rows):
+            if rec.deadline is not None and now_mono > rec.deadline:
+                if rec.settle():
+                    self._m_late.inc()
+                    self._finish(
+                        rec, "late", replica=rep.name,
+                        exc=DeadlineExceededError(
+                            "request deadline passed during batch "
+                            "coalescing/compute"
+                        ),
+                    )
+            elif rec.settle():
+                rep.served += 1
+                self._m_served.labels(rep.name).inc()
+                self._finish(rec, "ok", result=row, replica=rep.name)
+        # a whole good batch resets the slot's backoff ladder — unless this
+        # is a zombie incarnation that already lost its slot to a restart
+        if not self._stale(rep):
+            self._fails[rep.idx] = 0
+            if self._health is not None:
+                self._health.beat(f"replica.{rep.name}")
+        return True
+
+    # --------------------------------------------------------- supervisor
+
+    def _on_failure(self, rep: _Replica, batch, exc, *, kind: str) -> None:
+        err = f"{type(exc).__name__}: {exc}"
+        self._m_crashes.labels(rep.name, kind).inc()
+        self._event(
+            "replica_crash", replica=rep.name, kind=kind, gen=rep.gen, err=err
+        )
+        self._mark_down(rep)
+        for rec in batch:
+            self._requeue(rec, rep, err)
+        self._drain_slot(rep, err)
+
+    def _mark_down(self, rep: _Replica) -> None:
+        with self._state_lock:
+            if self._slots[rep.idx] is not rep or rep.state == "down":
+                return
+            rep.state = "down"
+            self._m_up.labels(rep.name).set(0)
+            self._fails[rep.idx] += 1
+            backoff = min(
+                self.restart_backoff_s * 2 ** (self._fails[rep.idx] - 1),
+                self.restart_backoff_max_s,
+            )
+            self._restart_at[rep.idx] = self._clock() + backoff
+            self._update_health()
+
+    def _update_health(self) -> None:
+        healthy = self._healthy_count()
+        self._m_healthy.set(healthy)
+        open_now = healthy < self.quorum
+        if open_now and not self._breaker_open:
+            self._breaker_open = True
+            self._m_breaker.set(1)
+            self._m_breaker_trips.inc()
+            self._event(
+                "breaker_open", healthy=healthy, quorum=self.quorum
+            )
+        elif not open_now and self._breaker_open:
+            self._breaker_open = False
+            self._m_breaker.set(0)
+            self._event(
+                "breaker_close", healthy=healthy, quorum=self.quorum
+            )
+
+    def _supervise(self) -> None:
+        while not self._closed:
+            now = self._clock()
+            for rep in list(self._slots):
+                if rep.state in ("up", "paused"):
+                    busy = rep.busy_since
+                    if busy is not None and now - busy > self.hang_timeout_s:
+                        # hung predict: abandon the thread, rescue the work
+                        self._m_crashes.labels(rep.name, "hang").inc()
+                        self._event(
+                            "replica_hang", replica=rep.name, gen=rep.gen,
+                            busy_s=round(now - busy, 3),
+                        )
+                        self._mark_down(rep)
+                        for rec in list(rep.pending):
+                            self._requeue(rec, rep, "hung predict")
+                        self._drain_slot(rep, "hung predict")
+                elif rep.state == "down":
+                    idx = rep.idx
+                    # racing submits may still land on a dead queue; keep
+                    # rescuing them every tick until the slot restarts
+                    self._drain_slot(rep, "replica down")
+                    if (
+                        now >= self._restart_at[idx]
+                        and not self._restarting[idx]
+                    ):
+                        self._restarting[idx] = True
+                        threading.Thread(
+                            target=self._restart_slot, args=(idx,),
+                            daemon=True, name=f"replica-restart-{rep.name}",
+                        ).start()
+            time.sleep(self._interval)
+
+    def _restart_slot(self, idx: int) -> None:
+        old = self._slots[idx]
+        try:
+            try:
+                engine = self._provider(idx)
+            except BaseException as e:  # noqa: BLE001 — a provider error is a failed restart
+                self._m_crashes.labels(old.name, "restart_error").inc()
+                self._event(
+                    "replica_restart_failed", replica=old.name,
+                    err=f"{type(e).__name__}: {e}",
+                )
+                with self._state_lock:
+                    self._fails[idx] += 1
+                    backoff = min(
+                        self.restart_backoff_s * 2 ** (self._fails[idx] - 1),
+                        self.restart_backoff_max_s,
+                    )
+                    self._restart_at[idx] = self._clock() + backoff
+                return
+            if self._closed:
+                return
+            rep = _Replica(idx, gen=old.gen + 1, engine=engine)
+            with self._state_lock:
+                self._slots[idx] = rep
+            self._start_worker(rep)
+            self._m_up.labels(rep.name).set(1)
+            self._m_restarts.labels(rep.name).inc()
+            self._event("replica_restart", replica=rep.name, gen=rep.gen)
+            if self._health is not None:
+                self._health.beat(f"replica.{rep.name}")
+            with self._state_lock:
+                self._update_health()
+            # anything stranded on the old incarnation's queue rides over
+            self._drain_slot(old, "superseded incarnation")
+        finally:
+            self._restarting[idx] = False
+
+
+class WeightSwapController:
+    """Parity- and canary-gated zero-downtime weight hot-swap over a
+    :class:`ReplicaSet` (state machine in the module docstring).
+
+    ``restore_fn(path) -> (params, batch_stats)`` defaults to
+    ``train.checkpoint.restore_inference_state`` (host-side restore — the
+    double buffer lives in host memory, one extra tree, not N).
+    ``features_fn(engine, images)`` defaults to ``engine.features`` — the
+    probe both parity legs run. ``on_promote(ckpt)`` lets the owner
+    repoint the replica provider (and its own bookkeeping) at the newly
+    shipped checkpoint.
+    """
+
+    def __init__(
+        self,
+        replicaset: ReplicaSet,
+        *,
+        restore_fn=None,
+        features_fn=None,
+        parity_images=None,
+        parity_min_cosine: float = 0.98,
+        canary_slo: str = "success_rate>=0.99",
+        canary_requests: int = 16,
+        canary_timeout_s: float = 30.0,
+        drain_timeout_s: float = 10.0,
+        on_promote=None,
+        registry=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rs = replicaset
+        self._restore_fn = restore_fn
+        self._features_fn = features_fn or (
+            lambda engine, images: engine.features(images)
+        )
+        self.parity_images = parity_images
+        self.parity_min_cosine = float(parity_min_cosine)
+        self.canary_slo = canary_slo
+        self.canary_requests = int(canary_requests)
+        self.canary_timeout_s = float(canary_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._on_promote = on_promote
+        self._clock = clock
+        self._swap_lock = threading.Lock()
+        self.last_report: dict | None = None
+        reg = registry if registry is not None else get_registry()
+        self._m_attempts = reg.counter(
+            "serve_swap_attempts_total", "weight hot-swap attempts"
+        )
+        self._m_promoted = reg.counter(
+            "serve_swap_promoted_total", "hot-swaps promoted to the full pool"
+        )
+        self._m_rollbacks = reg.counter(
+            "serve_swap_rollbacks_total",
+            "hot-swaps rolled back (parity gate, canary breach, canary "
+            "crash, or promote failure)",
+        )
+        self._m_rejected = reg.counter(
+            "serve_swap_rejected_total",
+            "hot-swaps rejected before any replica was flipped "
+            "(restore/graft failure, no routable canary)",
+        )
+        self._m_active = reg.gauge(
+            "serve_swap_active", "1 while a swap is in flight"
+        )
+        self._m_parity = reg.gauge(
+            "serve_swap_parity_cosine",
+            "min feature cosine of the last swap's parity gate",
+        )
+        self._m_canary_burn = reg.gauge(
+            "serve_swap_canary_burn",
+            "worst slow-window burn rate of the last canary evaluation",
+        )
+
+    # ------------------------------------------------------------ plumbing
+
+    def _restore(self, ckpt: str):
+        if self._restore_fn is not None:
+            params, stats = self._restore_fn(ckpt)
+        else:
+            from jumbo_mae_tpu_tpu.train.checkpoint import (
+                restore_inference_state,
+            )
+
+            params, stats = restore_inference_state(ckpt, to_device=False)
+        from jumbo_mae_tpu_tpu.infer.engine import _to_state_dict
+
+        params = _to_state_dict(params)
+        # the bad-push chaos site: `corrupt` diverges the tree (parity must
+        # catch it), `raise` models an unreadable checkpoint
+        params = fault_point("ckpt.load", key=str(ckpt), data=params)
+        return params, stats
+
+    def _event(self, etype: str, **fields) -> None:
+        self.rs._event(etype, **fields)
+
+    def _parity(self, engine, reference_feats) -> dict:
+        from jumbo_mae_tpu_tpu.infer.quant import feature_cosine
+
+        cand = np.asarray(self._features_fn(engine, self.parity_images))
+        cos = feature_cosine(np.asarray(reference_feats), cand)
+        cos_min = float(np.min(cos))
+        self._m_parity.set(cos_min)
+        return {
+            "cosine_min": cos_min,
+            "cosine_mean": float(np.mean(cos)),
+            "tolerance": self.parity_min_cosine,
+            "within_tolerance": cos_min >= self.parity_min_cosine,
+        }
+
+    def _reject(self, report: dict, stage: str, err: str) -> dict:
+        report.update(verdict="rejected", stage=stage, error=err)
+        self._m_rejected.inc()
+        self._event("swap_rejected", ckpt=report["ckpt"], stage=stage, err=err)
+        return report
+
+    def _rollback(self, report: dict, stage: str, detail: str) -> dict:
+        report.update(verdict="rolled_back", stage=stage, detail=detail)
+        self._m_rollbacks.inc()
+        self._event(
+            "swap_rollback", ckpt=report["ckpt"], stage=stage, detail=detail
+        )
+        return report
+
+    # ---------------------------------------------------------------- swap
+
+    def swap(self, ckpt: str) -> dict:
+        """Run one checkpoint through restore → parity → canary → promote;
+        returns the report dict (``verdict``: promoted | rolled_back |
+        rejected). One swap at a time; a second caller blocks."""
+        with self._swap_lock:
+            report = self._swap(str(ckpt))
+            self.last_report = report
+            return report
+
+    def _swap(self, ckpt: str) -> dict:
+        report: dict = {"ckpt": ckpt, "verdict": None, "stage": None}
+        self._m_attempts.inc()
+        self._m_active.set(1)
+        self._event("swap_start", ckpt=ckpt)
+        try:
+            try:
+                params, stats = self._restore(ckpt)
+            except BaseException as e:  # noqa: BLE001 — an unreadable push is a verdict
+                return self._reject(
+                    report, "restore", f"{type(e).__name__}: {e}"
+                )
+            canary = self.rs.first_routable()
+            if canary is None:
+                return self._reject(report, "canary_pick", "no routable replica")
+            report["canary"] = canary.name
+            canary_gen = canary.gen
+            self.rs.pause(canary.idx)
+            try:
+                self.rs.wait_idle(canary.idx, self.drain_timeout_s)
+                if self.parity_images is None:
+                    self.parity_images = self._default_probe(canary.engine)
+                ref = np.asarray(
+                    self._features_fn(canary.engine, self.parity_images)
+                )
+                try:
+                    snap = canary.engine.swap_weights(
+                        params, stats, ckpt=ckpt
+                    )
+                except BaseException as e:  # noqa: BLE001 — graft failure leaves old weights live
+                    return self._reject(
+                        report, "graft", f"{type(e).__name__}: {e}"
+                    )
+                try:
+                    parity = self._parity(canary.engine, ref)
+                except BaseException as e:  # noqa: BLE001 — a probe crash is a failed gate
+                    canary.engine.restore_snapshot(snap)
+                    return self._rollback(
+                        report, "parity", f"probe error: {type(e).__name__}: {e}"
+                    )
+                report["parity"] = parity
+                if not parity["within_tolerance"]:
+                    canary.engine.restore_snapshot(snap)
+                    return self._rollback(
+                        report, "parity",
+                        f"cosine_min {parity['cosine_min']:.4f} < "
+                        f"{self.parity_min_cosine}",
+                    )
+            finally:
+                self.rs.resume(canary.idx)
+            self._event(
+                "swap_canary", ckpt=ckpt, replica=canary.name,
+                cosine_min=report.get("parity", {}).get("cosine_min"),
+            )
+            breach, canary_report = self._canary_window(canary, canary_gen)
+            report["canary_eval"] = canary_report
+            if breach:
+                if self.rs.generation(canary.idx) == canary_gen:
+                    self.rs.pause(canary.idx)
+                    self.rs.wait_idle(canary.idx, self.drain_timeout_s)
+                    canary.engine.restore_snapshot(snap)
+                    self.rs.resume(canary.idx)
+                # else: the canary crashed and its replacement was rebuilt
+                # by the provider — which still serves the old weights
+                return self._rollback(report, "canary", canary_report["why"])
+            # promote: flip the survivors one at a time, never all at once
+            flipped = [(canary.idx, canary_gen, snap)]
+            for rep in list(self.rs._slots):
+                if rep.idx == canary.idx or rep.state != "up":
+                    continue
+                self.rs.pause(rep.idx)
+                self.rs.wait_idle(rep.idx, self.drain_timeout_s)
+                try:
+                    s = rep.engine.swap_weights(params, stats, ckpt=ckpt)
+                    flipped.append((rep.idx, rep.gen, s))
+                except BaseException as e:  # noqa: BLE001 — undo the partial promote
+                    self.rs.resume(rep.idx)
+                    for idx, gen, s2 in flipped:
+                        if self.rs.generation(idx) == gen:
+                            self.rs.pause(idx)
+                            self.rs.wait_idle(idx, self.drain_timeout_s)
+                            self.rs.replica(idx).engine.restore_snapshot(s2)
+                            self.rs.resume(idx)
+                    return self._rollback(
+                        report, "promote",
+                        f"{rep.name}: {type(e).__name__}: {e}",
+                    )
+                self.rs.resume(rep.idx)
+            if self._on_promote is not None:
+                try:
+                    self._on_promote(ckpt)
+                except Exception:  # noqa: BLE001 — bookkeeping must not fail a shipped swap
+                    pass
+            self._m_promoted.inc()
+            self._event("swap_promoted", ckpt=ckpt)
+            report.update(verdict="promoted", stage="promote")
+            return report
+        finally:
+            self._m_active.set(0)
+
+    def _default_probe(self, engine) -> np.ndarray:
+        size = getattr(engine, "image_size", 32)
+        return (
+            np.random.RandomState(0)
+            .randint(0, 256, (4, size, size, 3))
+            .astype(np.uint8)
+        )
+
+    def _canary_window(self, canary, canary_gen: int) -> tuple[bool, dict]:
+        """Watch only the canary replica's live outcomes through a
+        dedicated burn-rate tracker; returns (breached, report)."""
+        from jumbo_mae_tpu_tpu.obs.slo import SLOTracker, parse_slo
+
+        tracker = SLOTracker(
+            parse_slo(self.canary_slo),
+            window_s=max(self.canary_timeout_s, 1.0),
+            registry=NULL_REGISTRY,
+        )
+        seen = {"n": 0}
+
+        def feed(replica, outcome, latency_s, retries):
+            if replica == canary.name:
+                seen["n"] += 1
+                tracker.observe(latency_s, outcome)
+
+        self.rs.add_observer(feed)
+        self.rs.set_canary_preference(canary.name)
+        try:
+            deadline = self._clock() + self.canary_timeout_s
+            while self._clock() < deadline:
+                if seen["n"] >= self.canary_requests:
+                    break
+                if self.rs.generation(canary.idx) != canary_gen:
+                    return True, {
+                        "requests": seen["n"],
+                        "why": "canary replica crashed during the window",
+                    }
+                time.sleep(0.01)
+        finally:
+            self.rs.set_canary_preference(None)
+            self.rs.remove_observer(feed)
+        if self.rs.generation(canary.idx) != canary_gen:
+            return True, {
+                "requests": seen["n"],
+                "why": "canary replica crashed during the window",
+            }
+        ev = tracker.evaluate()
+        worst = max(
+            (o["burn_slow"] for o in ev["objectives"]), default=0.0
+        )
+        self._m_canary_burn.set(worst)
+        breached = bool(ev["degraded"]) or any(
+            o["breached"] for o in ev["objectives"]
+        )
+        why = (
+            "canary SLO breached: "
+            + "; ".join(
+                f"{o['name']}={o['value']} (burn {o['burn_slow']})"
+                for o in ev["objectives"]
+                if o["breached"]
+            )
+            if breached
+            else "ok"
+        )
+        return breached, {
+            "requests": seen["n"],
+            "burn_worst": worst,
+            "objectives": ev["objectives"],
+            "why": why,
+        }

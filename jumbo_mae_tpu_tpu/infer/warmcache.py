@@ -45,6 +45,7 @@ import os
 import pickle
 import re
 import sys
+import time
 import uuid
 from pathlib import Path
 
@@ -79,9 +80,22 @@ class WarmCache:
     All failure paths degrade to a miss: the caller compiles as if the
     cache were cold. ``stats()`` plus the ``infer_warmcache_*`` counters
     expose what actually happened.
+
+    ``quarantine/`` is bounded: entries beyond ``quarantine_keep`` (newest
+    kept) or older than ``quarantine_max_age_s`` are deleted when the cache
+    directory is claimed (construction) and after each new quarantine — a
+    crash-looping replica that corrupts an entry per restart must not fill
+    the disk with postmortem copies.
     """
 
-    def __init__(self, root: str | os.PathLike, *, registry=None):
+    def __init__(
+        self,
+        root: str | os.PathLike,
+        *,
+        registry=None,
+        quarantine_keep: int = 32,
+        quarantine_max_age_s: float = 7 * 24 * 3600.0,
+    ):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         if registry is None:
@@ -93,11 +107,21 @@ class WarmCache:
             "warm-start executable cache events",
             labels=("event",),
         )
+        self._m_pruned = registry.counter(
+            "infer_warmcache_quarantine_pruned_total",
+            "quarantined entries deleted by the count/age cap",
+        )
+        self.quarantine_keep = int(quarantine_keep)
+        self.quarantine_max_age_s = float(quarantine_max_age_s)
         self.hits = 0
         self.misses = 0
         self.puts = 0
         self.put_errors = 0
         self.quarantined = 0
+        self.quarantine_pruned = 0
+        # claim-time sweep: whoever opens the cache dir pays the prune, so
+        # the bound holds even if every previous process crashed mid-flight
+        self._prune_quarantine()
 
     # ------------------------------------------------------------------ io
 
@@ -201,6 +225,34 @@ class WarmCache:
             f"[warmcache] quarantined corrupt entry {path.name}: {err}",
             file=sys.stderr,
         )
+        self._prune_quarantine()
+
+    def _prune_quarantine(self) -> int:
+        """Enforce the quarantine count/age cap; returns entries deleted.
+        Newest entries win the count cap — the freshest corruption is the
+        one a postmortem wants."""
+        qdir = self.root / "quarantine"
+        try:
+            entries = sorted(
+                ((p.stat().st_mtime, p) for p in qdir.iterdir() if p.is_file()),
+                reverse=True,
+            )
+        except OSError:
+            return 0
+        now = time.time()
+        pruned = 0
+        for rank, (mtime, path) in enumerate(entries):
+            if rank < self.quarantine_keep and now - mtime <= self.quarantine_max_age_s:
+                continue
+            try:
+                path.unlink()
+            except OSError:
+                continue
+            pruned += 1
+        if pruned:
+            self.quarantine_pruned += pruned
+            self._m_pruned.inc(pruned)
+        return pruned
 
     def stats(self) -> dict:
         return {
@@ -211,6 +263,7 @@ class WarmCache:
             "puts": self.puts,
             "put_errors": self.put_errors,
             "quarantined": self.quarantined,
+            "quarantine_pruned": self.quarantine_pruned,
         }
 
 

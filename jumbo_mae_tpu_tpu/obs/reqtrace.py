@@ -16,7 +16,15 @@ full latency breakdown survives per request:
 - **fetch** — device→host transfer of the result rows;
 - plus the **bucket** the chunk ran in, the batch size, the **pad
   fraction**, and the terminal **outcome**:
-  ``ok | shed | deadline | aborted | shutdown``.
+  ``ok | shed | deadline | late | aborted | shutdown``
+  (``late`` = the deadline passed *after* admission, during coalescing or
+  compute — the resolution-time check `infer_requests_late_total` counts).
+
+With a replicated serving tier (``infer/replicaset.py``) each trace also
+carries **replica attribution**: ``replica_id`` (which replica served it),
+``retries`` (how many times it was requeued off a dying replica), and
+``requeued_from`` (the excluded-replica trail) — the exactly-once invariant
+extended with *who* served the request and *who failed to*.
 
 Each finished trace is emitted twice: into labeled ``request_*`` histograms
 on the metrics registry (scrapeable live) and, when an :class:`AccessLog`
@@ -38,7 +46,7 @@ from typing import Callable
 from jumbo_mae_tpu_tpu.obs.journal import RunJournal
 from jumbo_mae_tpu_tpu.obs.metrics import RATIO_BUCKETS, get_registry
 
-OUTCOMES = ("ok", "shed", "deadline", "aborted", "shutdown")
+OUTCOMES = ("ok", "shed", "deadline", "late", "aborted", "shutdown")
 
 
 class RequestTrace:
@@ -50,6 +58,7 @@ class RequestTrace:
         "rid", "task", "deadline_ms", "wall_ts", "t0", "t_admit", "t_flush",
         "queue_wait_s", "admission_s", "compute_s", "fetch_s",
         "batch", "bucket", "pad_fraction", "latency_s", "outcome", "error",
+        "replica_id", "retries", "requeued_from",
     )
 
     def __init__(self, rid: int, task: str, deadline_ms: float | None):
@@ -70,6 +79,9 @@ class RequestTrace:
         self.latency_s = None
         self.outcome = None
         self.error = None
+        self.replica_id = None
+        self.retries = 0
+        self.requeued_from = None
 
 
 class AccessLog:
@@ -191,11 +203,15 @@ class RequestTracer:
             if tr.t_admit is not None:
                 tr.admission_s = now - tr.t_admit
 
-    def flush_end(self, traces, *, run_s: float, batch: int) -> None:
+    def flush_end(self, traces, *, run_s: float, batch: int, breakdown=None) -> None:
         """Stamp the batch-level breakdown onto every trace in the flush.
         With an engine breakdown available, compute/fetch are the engine's
-        own split; otherwise the whole ``run_fn`` wall time is compute."""
-        bd = self._breakdown() if self._breakdown is not None else None
+        own split; otherwise the whole ``run_fn`` wall time is compute.
+        ``breakdown`` overrides the constructor callable for this flush —
+        a replica set has one engine per replica, so the right
+        ``last_breakdown`` is only known at the call site."""
+        fn = breakdown if breakdown is not None else self._breakdown
+        bd = fn() if fn is not None else None
         for tr in traces:
             tr.batch = batch
             if bd is not None:
@@ -243,6 +259,9 @@ class RequestTracer:
                 ("bucket", tr.bucket),
                 ("pad", tr.pad_fraction),
                 ("deadline_ms", tr.deadline_ms),
+                ("replica", tr.replica_id),
+                ("retries", tr.retries or None),
+                ("requeued_from", tr.requeued_from),
                 ("err", error),
             ):
                 if val is not None:

@@ -192,6 +192,42 @@ def test_batcher_outcome_deadline(tmp_path):
     assert rows[expiring.rid]["deadline_ms"] == 5.0
 
 
+def test_batcher_outcome_late_when_deadline_passes_after_admission(tmp_path):
+    """Regression: a deadline that lapses AFTER admission — here because an
+    injected ``serve.submit`` delay on a co-traveler held the batch open
+    past it — must resolve ``late`` (typed failure + counter), never
+    ``ok``."""
+    from jumbo_mae_tpu_tpu import faults
+
+    reg = MetricsRegistry()
+    log = AccessLog(tmp_path / "access")
+    tracer = RequestTracer(registry=reg, access_log=log)
+    mb = MicroBatcher(
+        lambda batch: batch, registry=reg, tracer=tracer,
+        max_batch=2, max_delay_ms=2000.0,
+    )
+    faults.install_plan("serve.submit:delay(0.3)@n=1")
+    try:
+        with mb:
+            # admitted immediately; the collector then waits for a second
+            # rider to fill max_batch=2
+            doomed = mb.submit(np.zeros(1), deadline_ms=100.0)
+            # this submit is delayed 0.3s by the fault — by the time the
+            # batch flushes, doomed's deadline has passed
+            rider = mb.submit(np.zeros(1))
+            assert rider.result(5.0) is not None
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(5.0)
+    finally:
+        faults.clear_plan()
+    rows = {r["rid"]: r for r in _rows(log)}
+    assert rows[doomed.rid]["outcome"] == "late"
+    assert rows[rider.rid]["outcome"] == "ok"
+    assert reg.counter("infer_requests_late_total", "x").value == 1
+    # late is not the pre-admission deadline path
+    assert reg.counter("infer_deadline_exceeded_total", "x").value == 0
+
+
 def test_batcher_outcome_aborted_on_run_fn_error(tmp_path):
     def boom(batch):
         raise RuntimeError("kaput")
@@ -333,15 +369,17 @@ def test_batcher_stress_every_future_exactly_one_outcome(tmp_path):
     assert len(set(rids)) == len(rids)  # rids unique
     by_rid = {r["rid"]: r for r in rows}
     # resolved futures and rows agree outcome-for-outcome via fut.rid
+    # (a DeadlineExceededError is "deadline" when caught before admission,
+    # "late" when the deadline lapsed after — both are the same typed error)
     for f in futures:
         row = by_rid[f.rid]
         exc = f.exception(timeout=0)
         expect = (
-            "ok" if exc is None
-            else "deadline" if isinstance(exc, DeadlineExceededError)
-            else "shutdown"
+            ("ok",) if exc is None
+            else ("deadline", "late") if isinstance(exc, DeadlineExceededError)
+            else ("shutdown",)
         )
-        assert row["outcome"] == expect
+        assert row["outcome"] in expect
     row_counts = {}
     for r in rows:
         row_counts[r["outcome"]] = row_counts.get(r["outcome"], 0) + 1

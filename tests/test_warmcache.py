@@ -124,6 +124,48 @@ def test_corrupt_entry_quarantined_not_fatal(tmp_path, corruption):
     assert wc.get(name) is not None
 
 
+def test_quarantine_bounded_by_count_and_age_at_claim_time(tmp_path):
+    """Regression: ``quarantine/`` must not grow without bound. Claiming
+    the cache directory prunes entries past the count cap (newest kept)
+    and past the age cap, and each new quarantine re-enforces the bound."""
+    import os
+    import time
+
+    from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+
+    qdir = tmp_path / "quarantine"
+    qdir.mkdir(parents=True)
+    now = time.time()
+    for i in range(3):  # fresh, staggered mtimes: fresh2 newest
+        p = qdir / f"fresh{i}.exe"
+        p.write_bytes(b"x")
+        os.utime(p, (now - 30 + i, now - 30 + i))
+    for i in range(2):  # well past the age cap
+        p = qdir / f"ancient{i}.exe"
+        p.write_bytes(b"x")
+        os.utime(p, (now - 7200, now - 7200))
+
+    reg = MetricsRegistry()
+    wc = WarmCache(
+        tmp_path, registry=reg, quarantine_keep=2, quarantine_max_age_s=3600.0
+    )
+    # 2 newest fresh entries survive; fresh0 loses the count cap, both
+    # ancient entries lose the age cap
+    assert sorted(p.name for p in qdir.iterdir()) == ["fresh1.exe", "fresh2.exe"]
+    assert wc.quarantine_pruned == 3
+    assert wc.stats()["quarantine_pruned"] == 3
+    assert reg.counter("infer_warmcache_quarantine_pruned_total", "x").value == 3
+
+    # a new quarantine event re-enforces the cap immediately
+    name = "t-b2-f32-none.exe"
+    assert wc.put(name, _tiny_executable())
+    (tmp_path / name).write_bytes(b"not a cache entry")
+    assert wc.get(name) is None
+    assert wc.stats()["quarantined"] == 1
+    assert len(list(qdir.iterdir())) == 2  # still at quarantine_keep
+    assert wc.stats()["quarantine_pruned"] == 4
+
+
 def test_digest_guards_payload_not_just_length(tmp_path):
     """A same-length bit flip inside the payload must fail the sha256 check
     (length checks alone would hand XLA corrupt bytes)."""

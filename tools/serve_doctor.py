@@ -18,6 +18,13 @@ wait vs coalescing vs compute vs fetch — the triage fork between "scale
 out", "shrink max_delay", and "shrink the model"); how each batch bucket
 behaved; and where sheds / deadline expiries / shutdown aborts clustered.
 
+With a replica-pool access log (``--serve --replicas N``) the report adds
+a per-replica latency/outcome table (keyed on each row's ``replica``
+field), retry clusters naming the replica whose failure forced each
+requeue (``requeued_from``) and who absorbed the retries, and a pool
+event timeline — crashes, hangs, restarts, breaker flips, and weight-swap
+verdicts (a ``swap_rollback`` also lands in the Verdict line).
+
 Without ``--slo`` the slow-request threshold defaults to 4x the median ok
 latency — a shape-based heuristic for "what would have annoyed a caller",
 documented in the report so nobody mistakes it for a configured objective.
@@ -85,9 +92,15 @@ def _windows_clock(windows: list[tuple[int, int]], window_s: float) -> str:
 
 
 def diagnose(
-    rows: list[dict], objectives: list[SLOObjective], *, window_s: float
+    rows: list[dict],
+    objectives: list[SLOObjective],
+    *,
+    window_s: float,
+    events: list[dict] | None = None,
 ) -> str:
-    """Render the markdown diagnosis for one serve run's request rows."""
+    """Render the markdown diagnosis for one serve run's request rows
+    (plus, when the log came from a replica pool, the non-request pool
+    events — crashes, restarts, breaker flips, swap verdicts)."""
     lines: list[str] = ["# Serve doctor report", ""]
     ok_rows = [r for r in rows if r["outcome"] == "ok"]
     ok_lat = sorted(r["lat_ms"] for r in ok_rows if r.get("lat_ms") is not None)
@@ -230,11 +243,63 @@ def diagnose(
         lines += ["", f"- worst bucket by p99: **{worst}** "
                   f"({fmt_num(worst_p99)} ms)", ""]
 
+    # ------------------------------------------------------------ replicas
+    rep_rows = [r for r in rows if r.get("replica") is not None]
+    if rep_rows:
+        by_rep: dict[str, list[dict]] = {}
+        for r in rep_rows:
+            by_rep.setdefault(str(r["replica"]), []).append(r)
+        lines += [
+            "## Replicas",
+            "",
+            "| replica | requests | ok | late | retried-in | p50 ms | p99 ms |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for name in sorted(by_rep):
+            sel = by_rep[name]
+            oks = [r for r in sel if r["outcome"] == "ok"]
+            lat = sorted(
+                r["lat_ms"] for r in oks if r.get("lat_ms") is not None
+            )
+            late = sum(1 for r in sel if r["outcome"] == "late")
+            retried = sum(1 for r in sel if r.get("retries"))
+            lines.append(
+                f"| {name} | {len(sel)} | {len(oks)} | {late} | {retried} "
+                f"| {fmt_num(_quantile(lat, 0.50)) if lat else '-'} "
+                f"| {fmt_num(_quantile(lat, 0.99)) if lat else '-'} |"
+            )
+        lines.append("")
+        # retry clusters: which replica's failure forced the requeues —
+        # the offline answer to "which replica died and who absorbed it"
+        retried_rows = [r for r in rows if r.get("retries")]
+        if retried_rows:
+            by_src: dict[str, list[dict]] = {}
+            for r in retried_rows:
+                src = str(r.get("requeued_from") or "unknown")
+                by_src.setdefault(src, []).append(r)
+            lines += ["### Retry clusters (by failed replica)", ""]
+            for src in sorted(by_src):
+                sel = by_src[src]
+                rids = contiguous_windows(r["rid"] for r in sel)
+                served_on = sorted(
+                    {str(r["replica"]) for r in sel if r.get("replica")}
+                )
+                ok_n = sum(1 for r in sel if r["outcome"] == "ok")
+                lines.append(
+                    f"- requeued off **{src}** ({len(sel)} request(s), "
+                    f"{ok_n} recovered ok"
+                    + (
+                        f" on {', '.join(served_on)}" if served_on else ""
+                    )
+                    + f"): {spans_text(rids, noun='request')}"
+                )
+            lines.append("")
+
     # ------------------------------------------------- non-ok rid clusters
     bad = [r for r in rows if r["outcome"] not in ("ok",)]
     if bad:
         lines += ["## Shed / deadline / abort clusters", ""]
-        for outcome in ("shed", "deadline", "aborted", "shutdown"):
+        for outcome in ("shed", "deadline", "late", "aborted", "shutdown"):
             sel = [r for r in bad if r["outcome"] == outcome]
             if sel:
                 rids = contiguous_windows(r["rid"] for r in sel)
@@ -243,6 +308,34 @@ def diagnose(
                     f"{spans_text(rids, noun='request')}"
                 )
         lines.append("")
+
+    # --------------------------------------------------- pool event timeline
+    POOL_EVENTS = (
+        "replica_crash", "replica_hang", "replica_restart",
+        "replica_restart_failed", "breaker_open", "breaker_close",
+        "swap_start", "swap_canary", "swap_rejected", "swap_rollback",
+        "swap_promoted",
+    )
+    pool_ev = [
+        e for e in (events or []) if e.get("type") in POOL_EVENTS
+    ]
+    if pool_ev:
+        lines += ["## Pool events", ""]
+        for e in pool_ev:
+            t_rel = e.get("ts", t0) - t0
+            detail = ", ".join(
+                f"{k}={v}"
+                for k, v in e.items()
+                if k not in ("ts", "seq", "type") and v is not None
+            )
+            lines.append(f"- t+{t_rel:.1f}s `{e['type']}` — {detail}")
+        lines.append("")
+        rollbacks = sum(1 for e in pool_ev if e["type"] == "swap_rollback")
+        if rollbacks:
+            verdict.append(
+                f"{rollbacks} weight swap(s) **rolled back** "
+                "(see Pool events)"
+            )
 
     # verdict goes up front, rendered last (it needs everything above)
     lines[2:2] = ["## Verdict", "", f"- {'; '.join(verdict)}", ""]
@@ -287,7 +380,7 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     objectives = parse_slo(args.slo) if args.slo else []
-    report = diagnose(rows, objectives, window_s=args.window_s)
+    report = diagnose(rows, objectives, window_s=args.window_s, events=events)
     return write_report(report, args.out, tool="serve_doctor")
 
 
