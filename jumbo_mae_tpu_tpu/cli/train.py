@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import math
+import os
 import time
 from pathlib import Path
 
@@ -46,6 +47,7 @@ from jumbo_mae_tpu_tpu.faults import (
     fault_point,
     faults_active,
     install_plan,
+    set_host_index,
 )
 from jumbo_mae_tpu_tpu.models import (
     ClassificationModel,
@@ -63,8 +65,10 @@ from jumbo_mae_tpu_tpu.train import (
     make_train_step,
 )
 from jumbo_mae_tpu_tpu.obs import (
+    FleetAggregator,
     FlightRecorder,
     HealthState,
+    HostBeacon,
     RunJournal,
     TelemetryServer,
     env_fingerprint,
@@ -428,6 +432,10 @@ def train(cfg: TrainConfig) -> dict:
         plan = install_plan(run.faults)
         print(f"[faults] injection plan active: sites={plan.sites()}")
     process_count = jax.process_count()
+    host_index = jax.process_index()
+    # pin the fault layer's host identity (the `@host=` selector) before any
+    # site can fire; mirrored into GRAFT_HOST so data workers inherit it
+    set_host_index(host_index)
     if run.train_batch_size % (process_count * run.grad_accum):
         raise ValueError(
             f"process_count * grad_accum ({process_count} * {run.grad_accum}) "
@@ -618,7 +626,7 @@ def train(cfg: TrainConfig) -> dict:
     )
     eval_step = make_eval_step(mesh, state_sharding, mode=mode_key)
 
-    is_main = jax.process_index() == 0
+    is_main = host_index == 0
     if is_main:
         # startup parameter table (parity: the reference's module.tabulate
         # pre-flight print, /root/reference/src/pretraining.py:214)
@@ -698,18 +706,22 @@ def train(cfg: TrainConfig) -> dict:
             evaluate(eval_step, state, valid_factory(), pad_batch),
         )
 
-    # run-history diagnostics (host 0, like the logger): the crash-safe
-    # journal under <run_dir>/journal/ and the black-box flight recorder
-    # dumping into <run_dir>/ on non-finite steps, rollbacks, SIGTERM, or
-    # an escaping exception. Installed AFTER the preemption guard so its
-    # SIGTERM handler dumps first, then chains into graceful checkpointing.
+    # run-history diagnostics (EVERY host, unlike the logger): the crash-safe
+    # journal — host 0 under <run_dir>/journal/, host i under
+    # <run_dir>/journal-host<i>/, every row host-tagged, merged offline by
+    # read_merged_journal — and the black-box flight recorder dumping into
+    # <run_dir>/ (host-tagged filenames off host 0) on non-finite steps,
+    # rollbacks, SIGTERM, or an escaping exception. Installed AFTER the
+    # preemption guard so its SIGTERM handler dumps first, then chains into
+    # graceful checkpointing.
     run_dir = Path(run.output_dir) / run.name
-    journal = (
-        RunJournal(run_dir / "journal") if run.journal and is_main else None
-    )
+    journal = None
+    if run.journal:
+        jdir = run_dir / ("journal" if is_main else f"journal-host{host_index}")
+        journal = RunJournal(jdir, host=host_index)
     flightrec = (
-        FlightRecorder(run_dir, capacity=run.flightrec_steps)
-        if run.flightrec_steps > 0 and is_main
+        FlightRecorder(run_dir, capacity=run.flightrec_steps, host=host_index)
+        if run.flightrec_steps > 0
         else None
     )
     if flightrec is not None:
@@ -744,6 +756,37 @@ def train(cfg: TrainConfig) -> dict:
         diag_every=run.diag_every,
         diag_groups=list(diag_names),
     )
+
+    # fleet health (obs/fleet.py): every host rewrites its beacon each step;
+    # host 0 additionally aggregates the beacon dir into fleet_* gauges (on
+    # the exporter's scrape, so idle scans cost nothing), journals straggler/
+    # lost/rejoined transitions via _emit, and feeds /healthz (soft degraded)
+    beacon = None
+    fleet_agg = None
+    beacon_stats: dict = {}
+    if run.fleet:
+        beacon = HostBeacon(run_dir / "fleet", host=host_index)
+        if is_main:
+            fleet_agg = FleetAggregator(
+                run_dir / "fleet",
+                expected_hosts=process_count,
+                lag_steps=run.fleet_lag_steps,
+                ratio=run.fleet_ratio,
+                dead_after_s=run.fleet_dead_after_s,
+                on_event=_emit,
+            )
+            health.probe("fleet", fleet_agg.summary)
+            health.degraded_when(fleet_agg.degraded)
+            if telemetry is not None:
+                telemetry.add_pre_scrape(fleet_agg.scan)
+
+    def _beacon_write(step_now: int) -> None:
+        if beacon is None:
+            return
+        try:
+            beacon.write(step=step_now, **beacon_stats)
+        except OSError:  # a shared-fs hiccup must not kill the run
+            pass
 
     train_iter, source, cursor_log = make_train_iterator(
         cfg, mesh, per_process, start_step, data_cursor,
@@ -812,6 +855,9 @@ def train(cfg: TrainConfig) -> dict:
     if run.chrome_trace and is_main:
         start_chrome_trace()
     window_t0, window_wait = time.perf_counter(), 0.0
+    window_steps = 0  # dispatches this log window (beacon step-time EMA)
+    bad_total = 0  # cumulative sentinel-bad steps (beacon field)
+    step_ema_s: float | None = None
 
     exit_reason = "completed"
     pending: list = []  # [(step, device-metrics)] fetched at log time
@@ -823,6 +869,12 @@ def train(cfg: TrainConfig) -> dict:
         with trace(run.profile_dir or None):
             while step < run.training_steps:
                 step += 1
+                # beacon BEFORE the data wait: under synchronous SPMD the
+                # fetched step counts stay lockstep, but a host stuck waiting
+                # on data sits at this step's entry while its peers dispatch
+                # ahead — that dispatch gap is exactly what fleet_step_lag sees
+                _beacon_write(step)
+                window_steps += 1
                 with sp_wait:
                     batch = next(train_iter)
                 window_wait += sp_wait.last_s
@@ -969,7 +1021,34 @@ def train(cfg: TrainConfig) -> dict:
                     now = time.perf_counter()
                     wait_frac = window_wait / max(now - window_t0, 1e-9)
                     g_wait_frac.set(wait_frac)
-                    window_t0, window_wait = now, 0.0
+                    if beacon is not None:
+                        st = (now - window_t0) / max(window_steps, 1)
+                        step_ema_s = (
+                            st
+                            if step_ema_s is None
+                            else 0.5 * step_ema_s + 0.5 * st
+                        )
+                        bad_total += len(window_bad)
+                        beacon_stats.update(
+                            step_time_ema_s=round(step_ema_s, 4),
+                            data_wait_fraction=round(wait_frac, 4),
+                            shard_retries=int(
+                                reg.counter(
+                                    "data_shard_retries_total",
+                                    "shard reads retried after a "
+                                    "transient failure",
+                                ).value
+                            ),
+                            shard_quarantines=len(QUARANTINE.snapshot()),
+                            sentinel_bad_steps=bad_total,
+                        )
+                        _beacon_write(step)
+                        if fleet_agg is not None:
+                            try:
+                                fleet_agg.scan()
+                            except OSError:
+                                pass
+                    window_t0, window_wait, window_steps = now, 0.0, 0
                     logger.log(summary, step=step)
                     last_metrics = summary
 
@@ -1116,6 +1195,7 @@ def train(cfg: TrainConfig) -> dict:
         raise
     finally:
         _emit("shutdown", reason=exit_reason, step=step)
+        _beacon_write(step)  # final heartbeat: a clean exit is not a lost host
         if flightrec is not None:
             flightrec.uninstall()
         if journal is not None:
@@ -1152,13 +1232,46 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="call jax.distributed.initialize() (multi-host pods)",
     )
+    parser.add_argument(
+        "--coordinator",
+        type=str,
+        default=None,
+        help="explicit coordinator address (host:port) for --distributed; "
+        "needed off-TPU (e.g. the multi-process CPU fleet smoke) where "
+        "auto-detection has no metadata server to ask",
+    )
+    parser.add_argument(
+        "--num-processes",
+        type=int,
+        default=None,
+        help="process count for --distributed with --coordinator",
+    )
+    parser.add_argument(
+        "--process-id",
+        type=int,
+        default=None,
+        help="this process's index for --distributed with --coordinator",
+    )
     return parser
 
 
 def main(argv: list[str] | None = None):
     args = build_parser().parse_args(argv)
     if args.distributed:
-        jax.distributed.initialize()
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # multi-process CPU (the CI fleet smoke): cross-process
+            # collectives need the gloo backend, and the flag must land
+            # before the first backend touch or XLA raises "Multiprocess
+            # computations aren't implemented on the CPU backend"
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        if args.coordinator:
+            jax.distributed.initialize(
+                coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+            )
+        else:
+            jax.distributed.initialize()
     cfg = load_config(args.config, args.overrides)
     metrics = train(cfg)
     print("[train] done:", metrics)

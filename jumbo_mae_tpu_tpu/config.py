@@ -114,6 +114,19 @@ class RunConfig:
     telemetry: bool = False
     telemetry_port: int = 9100
     telemetry_host: str = "0.0.0.0"
+    # fleet health (obs/fleet.py): every process atomically rewrites a
+    # per-host beacon under <run_dir>/fleet/ (step, step-time EMA, data-wait
+    # fraction, shard retries/quarantines, sentinel bad steps, heartbeat);
+    # host 0 aggregates the beacon dir into fleet_*{host=} gauges, journals
+    # fleet_straggler / fleet_host_lost / fleet_host_rejoined transitions,
+    # and feeds /healthz (degraded is soft — never a 503). A host is a
+    # straggler when it trails the fleet-max step by fleet_lag_steps or its
+    # step-time EMA exceeds fleet_ratio x the fleet median; lost when its
+    # heartbeat is older than fleet_dead_after_s.
+    fleet: bool = True
+    fleet_lag_steps: int = 2
+    fleet_ratio: float = 1.5
+    fleet_dead_after_s: float = 60.0
     # serving SLOs (jumbo_mae_tpu_tpu/obs/slo.py): objectives like
     # "p99_latency_ms<=250;success_rate>=0.99" evaluated over a rolling
     # slow window with a fast confirmation window (0 = window_s / 12);

@@ -11,9 +11,11 @@ from jumbo_mae_tpu_tpu.faults.inject import (
     FaultRule,
     active_plan,
     clear_plan,
+    current_host_index,
     fault_point,
     faults_active,
     install_plan,
+    set_host_index,
 )
 from jumbo_mae_tpu_tpu.faults.sentinel import (
     DivergenceError,
@@ -30,8 +32,10 @@ __all__ = [
     "SentinelConfig",
     "active_plan",
     "clear_plan",
+    "current_host_index",
     "fault_point",
     "faults_active",
     "guarded_apply_gradients",
     "install_plan",
+    "set_host_index",
 ]

@@ -23,6 +23,8 @@ Plan grammar (rules separated by ``;``)::
             | 'n%' K '=' R        -- every K-th invocation with remainder R
             | 'p=' F              -- seeded Bernoulli(F) per invocation
             | 'key~' SUBSTR       -- only when the site key contains SUBSTR
+            | 'host=' I           -- only on process/host index I of a
+                                     multi-process run (fleet chaos)
     seed    = 'seed=' N           -- standalone rule: seeds every 'p=' draw
 
 All selectors of a rule must match for it to fire. Examples::
@@ -35,6 +37,13 @@ All selectors of a rule must match for it to fire. Examples::
     serve.replica:raise(RuntimeError)@key~r1,n<1  # crash replica r1's first batch
     serve.replica:delay(5.0)@key~r2               # wedge replica r2 (hang path)
     ckpt.load:corrupt(4)                          # diverge a hot-swap restore
+    data.decode:delay(0.2)@host=1                 # straggle host 1 of a pod
+
+The ``host=`` selector resolves the current process's host index lazily at
+fire time: an explicit :func:`set_host_index` (``cli/train.py`` pins it
+right after distributed init, and exports it via ``GRAFT_HOST`` so data
+worker subprocesses inherit the identity), else the ``GRAFT_HOST`` env var,
+else ``jax.process_index()`` when jax is already imported, else 0.
 
 Known sites (free-form names are allowed; these are the wired ones):
 ``data.shard_open``, ``data.decode``, ``train.loss``, ``train.grad``,
@@ -110,6 +119,9 @@ class FaultRule:
             elif kind == "key~":
                 if key is None or val not in str(key):
                     return False
+            elif kind == "host=":
+                if current_host_index() != val:
+                    return False
         return True
 
 
@@ -132,6 +144,8 @@ def _parse_selector(text: str) -> tuple[str, object]:
     if text.startswith("n="):
         lo, sep, hi = text[2:].partition("..")
         return ("n=", (int(lo), int(hi) if sep else int(lo)))
+    if text.startswith("host="):
+        return ("host=", int(text[len("host="):]))
     raise ValueError(f"unknown fault selector {text!r}")
 
 
@@ -282,6 +296,53 @@ def _corrupt_bytes(data, nbytes: int, seed: int, salt: int):
             out[i] = (-3.0 * arr - 0.5).astype(arr.dtype)
         return tree_util.tree_unflatten(treedef, out)
     return data
+
+
+# ------------------------------------------------------------ host identity
+
+_HOST_INDEX: int | None = None
+_HOST_ENV = "GRAFT_HOST"
+
+
+def set_host_index(index: int | None) -> None:
+    """Pin this process's host index for ``@host=`` selectors and mirror it
+    into the ``GRAFT_HOST`` env var so data-worker subprocesses (which
+    activate the same plan via ``GRAFT_FAULTS``) inherit the identity.
+    ``None`` resets to lazy resolution (tests)."""
+    global _HOST_INDEX
+    if index is None:
+        _HOST_INDEX = None
+        os.environ.pop(_HOST_ENV, None)
+    else:
+        _HOST_INDEX = int(index)
+        os.environ[_HOST_ENV] = str(_HOST_INDEX)
+
+
+def current_host_index() -> int:
+    """The host index ``@host=`` compares against. Resolution order:
+    :func:`set_host_index` > ``GRAFT_HOST`` env > ``jax.process_index()``
+    when jax is already imported (this layer never imports it) > 0. The
+    resolved value is cached; the bare-0 fallback is not, since distributed
+    init may simply not have happened yet."""
+    global _HOST_INDEX
+    if _HOST_INDEX is not None:
+        return _HOST_INDEX
+    env = os.environ.get(_HOST_ENV)
+    if env is not None:
+        try:
+            _HOST_INDEX = int(env)
+            return _HOST_INDEX
+        except ValueError:
+            pass
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            _HOST_INDEX = int(sys.modules["jax"].process_index())
+            return _HOST_INDEX
+        except Exception:  # noqa: BLE001 - backend not initialized yet
+            pass
+    return 0
 
 
 # ---------------------------------------------------------------- installers

@@ -19,8 +19,12 @@
   the benches append to and ``tools/perf_doctor.py`` diagnoses.
 - ``obs.modelstats`` — per-layer-group grad/param/update statistics computed
   inside the jitted train step (``run.diag_every``).
-- ``obs.journal``  — append-only crash-safe JSONL run journal + reader.
-- ``obs.flightrec`` — crash flight recorder (ring buffer + black-box dumps).
+- ``obs.journal``  — append-only crash-safe JSONL run journal (per-host
+  segments under multi-process runs) + single and merged multi-host readers.
+- ``obs.flightrec`` — crash flight recorder (ring buffer + black-box dumps,
+  host-tagged filenames on non-zero hosts).
+- ``obs.fleet``    — file-based fleet-health protocol: per-host beacons +
+  the host-0 aggregator (straggler/lost detection, ``fleet_*`` gauges).
 - ``obs.reqtrace`` — per-request trace context for the serving path + the
   crash-safe JSONL access log (``tools/serve_doctor.py`` reads it offline).
 - ``obs.slo``      — declarative SLO objectives, rolling-window burn rates,
@@ -33,12 +37,14 @@ modules remain as import-compatible shims over this package.
 """
 
 from jumbo_mae_tpu_tpu.obs.exporter import HealthState, TelemetryServer
+from jumbo_mae_tpu_tpu.obs.fleet import FleetAggregator, HostBeacon, read_beacons
 from jumbo_mae_tpu_tpu.obs.flightrec import FlightRecorder
 from jumbo_mae_tpu_tpu.obs.journal import (
     RunJournal,
     env_fingerprint,
     journal_dir,
     read_journal,
+    read_merged_journal,
 )
 from jumbo_mae_tpu_tpu.obs.modelstats import (
     STAT_NAMES,
@@ -126,8 +132,10 @@ __all__ = [
     "ChipSpec",
     "Counter",
     "Family",
+    "FleetAggregator",
     "FlightRecorder",
     "Gauge",
+    "HostBeacon",
     "HealthState",
     "Histogram",
     "LATENCY_BUCKETS",
@@ -179,8 +187,10 @@ __all__ = [
     "publish_cost",
     "publish_drift",
     "publish_group_stats",
+    "read_beacons",
     "read_journal",
     "read_ledger",
+    "read_merged_journal",
     "resolve_history_path",
     "roofline",
     "set_registry",

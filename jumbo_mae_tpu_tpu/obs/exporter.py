@@ -51,7 +51,7 @@ class HealthState:
         self._max_age: dict[str, float] = {}
         self._beats: dict[str, float] = {}
         self._probes: dict[str, object] = {}
-        self._degraded_fn = None
+        self._degraded_fns: list = []
 
     def set_ready(self, ready: bool = True, detail: str = "") -> None:
         with self._lock:
@@ -78,14 +78,18 @@ class HealthState:
             self._probes[name] = fn
 
     def degraded_when(self, fn) -> None:
-        """Attach a zero-arg predicate (e.g. ``SLOTracker.degraded``) whose
-        truthiness is surfaced as ``body["degraded"]``. Degraded is *soft*:
-        the process is serving but missing its SLO — it must NOT flip the
-        503 readiness/liveness verdict, or an autoscaler reacting to load
+        """Attach a zero-arg predicate (e.g. ``SLOTracker.degraded``,
+        ``FleetAggregator.degraded``) whose truthiness feeds
+        ``body["degraded"]``. Repeated calls *compose* — the body reports
+        the OR of every registered predicate, so the SLO tracker, the
+        replica breaker, and the fleet aggregator can all contribute
+        without overwriting each other. Degraded is *soft*: the process is
+        serving but missing its SLO — it must NOT flip the 503
+        readiness/liveness verdict, or an autoscaler reacting to load
         would see its overloaded replicas drop out of rotation and make the
         overload worse."""
         with self._lock:
-            self._degraded_fn = fn
+            self._degraded_fns.append(fn)
 
     def report(self) -> tuple[bool, dict]:
         now = time.monotonic()
@@ -93,7 +97,7 @@ class HealthState:
             ready, detail = self._ready, self._detail
             watches = dict(self._max_age)
             probes = dict(self._probes)
-            degraded_fn = self._degraded_fn
+            degraded_fns = list(self._degraded_fns)
         checks = {}
         ok = ready
         for name, budget in sorted(watches.items()):
@@ -107,11 +111,18 @@ class HealthState:
                 "ok": alive,
             }
         body = {"ok": ok, "ready": ready, "checks": checks}
-        if degraded_fn is not None:
-            try:
-                body["degraded"] = bool(degraded_fn())
-            except Exception as e:  # noqa: BLE001 — never break /healthz
-                body["degraded"] = f"probe error: {type(e).__name__}: {e}"
+        if degraded_fns:
+            degraded: bool | str = False
+            for fn in degraded_fns:
+                try:
+                    if fn():
+                        degraded = True
+                        break
+                except Exception as e:  # noqa: BLE001 — never break /healthz
+                    # an erroring probe only reports when no other says True
+                    if degraded is False:
+                        degraded = f"probe error: {type(e).__name__}: {e}"
+            body["degraded"] = degraded
         if probes:
             info = {}
             for name, fn in sorted(probes.items()):

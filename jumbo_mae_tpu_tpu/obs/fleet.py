@@ -1,0 +1,428 @@
+"""File-based fleet-health protocol: per-host beacons + a host-0 aggregator.
+
+At pod scale the failure modes that dominate are exactly the ones a
+single-host telemetry stack cannot see: one straggler host dragging every
+synchronous step (collectives make the *fleet* as slow as its slowest
+member), one host silently quarantining its data shards, or one host dying
+outright while the others hang in a collective. This module makes those
+visible with **no networking at all** — the only shared medium is the run
+directory (NFS / GCS-fuse on a real pod, tmpfs in tests), so the protocol
+is CPU-testable with plain files and never adds an RPC dependency to the
+train loop.
+
+Protocol:
+
+- every process owns one **beacon** file, ``<run_dir>/fleet/host-<i>.json``,
+  rewritten atomically (tmp + ``os.replace``) at each step entry and log
+  boundary. Schema: ``host``, ``pid``, ``hostname``, ``step``, ``heartbeat``
+  (epoch seconds), ``step_time_ema_s``, ``data_wait_fraction``,
+  ``shard_retries``, ``shard_quarantines``, ``sentinel_bad_steps``. A reader
+  can never observe a torn beacon — only the previous or the next version.
+- host 0 runs a :class:`FleetAggregator` that scans the beacon dir (at its
+  own log boundaries and as an exporter pre-scrape hook), publishes
+  ``fleet_*{host=}`` gauges, and drives a per-host status machine:
+
+  * **straggler** — the host trails the fleet-max step by ``lag_steps``,
+    its step-time EMA exceeds ``ratio`` × the fleet median, or its data-wait
+    fraction is both high (≥ 0.3) and far above the fleet median (≥ 2×) —
+    the last one matters because a fully synchronous fleet is *lockstep*
+    (steps and EMAs equalize; only the time breakdown differs). Needs ≥ 2
+    live hosts. Entering emits a ``fleet_straggler`` journal event carrying
+    the dominant *symptom* (``data_wait`` / ``step_time`` / ``step_lag``).
+  * **lost** — the heartbeat is older than ``dead_after_s``; emits
+    ``fleet_host_lost``. A fresh beacon after that emits
+    ``fleet_host_rejoined`` (a restarted process rejoining the run).
+
+  ``degraded()`` (any host straggling/lost) is shaped for
+  :meth:`HealthState.degraded_when` — soft, never a 503 — and ``summary()``
+  for ``HealthState.probe`` so ``/healthz`` carries per-host health.
+
+Event payloads name the affected host ``host_id`` — ``host`` on a journal
+row is the row's *writer* (always 0 for aggregator events), stamped by
+:class:`~jumbo_mae_tpu_tpu.obs.journal.RunJournal`.
+
+Caveats by design: beacon timestamps are wall clocks compared across hosts,
+so thresholds are seconds-scale and assume NTP-sane skew; a host that never
+beacons at all (crashed before its first step) shows up as *missing* in the
+summary but emits no lost event — there is no heartbeat history to age.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from pathlib import Path
+
+from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry, get_registry
+
+__all__ = ["FleetAggregator", "HostBeacon", "read_beacons"]
+
+_BEACON_PREFIX = "host-"
+_BEACON_SUFFIX = ".json"
+
+# per-host gauge fields copied straight from beacon → fleet_<name>{host=}
+_BEACON_GAUGES = (
+    ("step", "fleet_step", "last step this host reported"),
+    (
+        "step_time_ema_s",
+        "fleet_step_time_ema_seconds",
+        "per-host step-time EMA from its beacon",
+    ),
+    (
+        "data_wait_fraction",
+        "fleet_data_wait_fraction",
+        "per-host share of wall time waiting on data (last log window)",
+    ),
+    ("shard_retries", "fleet_shard_retries", "per-host shard read retries"),
+    (
+        "shard_quarantines",
+        "fleet_shard_quarantines",
+        "per-host shards abandoned by the retry layer",
+    ),
+    (
+        "sentinel_bad_steps",
+        "fleet_sentinel_bad_steps",
+        "per-host non-finite/skipped steps seen by the sentinel",
+    ),
+)
+
+
+class HostBeacon:
+    """Writer half: one process's atomically-replaced health file.
+
+    ``write`` is called from the step loop (heartbeat cadence), so it must
+    be cheap: one small JSON dump + rename, no fsync — a beacon lost to a
+    power cut is immediately superseded by the next one, durability buys
+    nothing here (the *journal* owns durable history).
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        host: int,
+        pid: int | None = None,
+        hostname: str | None = None,
+    ):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.host = int(host)
+        self.pid = os.getpid() if pid is None else int(pid)
+        self.hostname = hostname or socket.gethostname()
+        self.path = self.directory / f"{_BEACON_PREFIX}{self.host}{_BEACON_SUFFIX}"
+        # pid-suffixed tmp name: two processes mistakenly sharing a host
+        # index corrupt nothing — last rename wins, both files stay whole
+        self._tmp = self.directory / f".{_BEACON_PREFIX}{self.host}.tmp.{self.pid}"
+        self.writes = 0
+
+    def write(
+        self,
+        *,
+        step: int,
+        step_time_ema_s: float | None = None,
+        data_wait_fraction: float | None = None,
+        shard_retries: int = 0,
+        shard_quarantines: int = 0,
+        sentinel_bad_steps: int = 0,
+        now: float | None = None,
+        **extra,
+    ) -> dict:
+        """Publish this host's current health; returns the payload written."""
+        payload = {
+            "host": self.host,
+            "pid": self.pid,
+            "hostname": self.hostname,
+            "step": int(step),
+            "heartbeat": round(time.time() if now is None else float(now), 3),
+            "step_time_ema_s": (
+                None if step_time_ema_s is None else round(float(step_time_ema_s), 6)
+            ),
+            "data_wait_fraction": (
+                None if data_wait_fraction is None else round(float(data_wait_fraction), 4)
+            ),
+            "shard_retries": int(shard_retries),
+            "shard_quarantines": int(shard_quarantines),
+            "sentinel_bad_steps": int(sentinel_bad_steps),
+        }
+        payload.update(extra)
+        self._tmp.write_text(json.dumps(payload, separators=(",", ":")))
+        os.replace(self._tmp, self.path)
+        self.writes += 1
+        return payload
+
+
+def read_beacons(directory: str | Path) -> dict[int, dict]:
+    """Reader half: ``{host index → beacon payload}`` for every parseable
+    beacon under ``directory``. Atomic replacement means a *well-behaved*
+    writer can never be caught torn, but a corrupt or foreign file (manual
+    edit, partial copy of the run dir) is skipped, never an error."""
+    out: dict[int, dict] = {}
+    d = Path(directory)
+    if not d.is_dir():
+        return out
+    for p in sorted(d.glob(f"{_BEACON_PREFIX}*{_BEACON_SUFFIX}")):
+        name = p.name[len(_BEACON_PREFIX) : -len(_BEACON_SUFFIX)]
+        try:
+            host = int(name)
+        except ValueError:
+            continue
+        try:
+            rec = json.loads(p.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(rec, dict):
+            out[host] = rec
+    return out
+
+
+class FleetAggregator:
+    """Host-0 half: scan beacons → gauges + status machine + journal events.
+
+    ``scan()`` is safe to call from both the train loop and the exporter's
+    scrape thread (one lock); it is cheap — N small file reads — so calling
+    it at every scrape keeps /metrics live even while host 0's main thread
+    is blocked inside a collective waiting on the very host being diagnosed.
+    """
+
+    OK, STRAGGLER, LOST = "ok", "straggler", "lost"
+
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        expected_hosts: int | None = None,
+        lag_steps: int = 2,
+        ratio: float = 1.5,
+        dead_after_s: float = 60.0,
+        on_event=None,
+        registry: MetricsRegistry | None = None,
+    ):
+        self.directory = Path(directory)
+        self.expected_hosts = None if expected_hosts is None else int(expected_hosts)
+        self.lag_steps = max(1, int(lag_steps))
+        self.ratio = float(ratio)
+        self.dead_after_s = float(dead_after_s)
+        self.on_event = on_event  # on_event(etype, **payload) → journal
+        reg = registry if registry is not None else get_registry()
+        self._g_beacon = [
+            (field, reg.gauge(name, help, labels=("host",)))
+            for field, name, help in _BEACON_GAUGES
+        ]
+        self._g_lag = reg.gauge(
+            "fleet_step_lag",
+            "steps this host trails the fleet-max reported step",
+            labels=("host",),
+        )
+        self._g_age = reg.gauge(
+            "fleet_heartbeat_age_seconds",
+            "seconds since this host's beacon was last refreshed",
+            labels=("host",),
+        )
+        self._g_straggler = reg.gauge(
+            "fleet_straggler",
+            "1 while this host is flagged a straggler (lag, step-time ratio, "
+            "or data-wait dominance)",
+            labels=("host",),
+        )
+        self._g_up = reg.gauge(
+            "fleet_host_up",
+            "1 while this host's heartbeat is fresher than run.fleet_dead_after_s",
+            labels=("host",),
+        )
+        self._g_alive = reg.gauge("fleet_hosts_alive", "hosts with a fresh heartbeat")
+        self._g_expected = reg.gauge(
+            "fleet_hosts_expected", "process count this run was launched with"
+        )
+        self._lock = threading.Lock()
+        self._status: dict[int, str] = {}
+        self._summary: dict = {"hosts": {}, "alive": 0, "stragglers": [], "lost": []}
+        self._last_scan = 0.0  # monotonic; rate-limits the /healthz probes
+
+    # ------------------------------------------------------------- scanning
+
+    def scan(self, now: float | None = None) -> dict:
+        """Read every beacon, refresh gauges, run the status machine, emit
+        transition events. Returns (and caches) the fleet summary."""
+        with self._lock:
+            return self._scan_locked(time.time() if now is None else float(now))
+
+    def _scan_locked(self, now: float) -> dict:
+        beacons = read_beacons(self.directory)
+        alive = {
+            h: b
+            for h, b in beacons.items()
+            if now - float(b.get("heartbeat", 0.0)) <= self.dead_after_s
+        }
+        max_step = max(
+            (int(b.get("step", 0)) for b in (alive or beacons).values()), default=0
+        )
+        # LOWER-middle medians: with an even fleet (the common 2-host case)
+        # the upper middle would be the slow host's own number, so no host
+        # could ever exceed ratio × median — the straggler check would be
+        # structurally blind exactly where the CI smoke exercises it
+        emas = sorted(
+            float(b["step_time_ema_s"])
+            for b in alive.values()
+            if b.get("step_time_ema_s")
+        )
+        median_ema = emas[(len(emas) - 1) // 2] if emas else 0.0
+        waits = sorted(
+            float(b["data_wait_fraction"])
+            for b in alive.values()
+            if b.get("data_wait_fraction") is not None
+        )
+        median_wait = waits[(len(waits) - 1) // 2] if waits else 0.0
+
+        hosts: dict[int, dict] = {}
+        events: list[tuple[str, dict]] = []
+        for h, b in sorted(beacons.items()):
+            age = max(0.0, now - float(b.get("heartbeat", 0.0)))
+            step = int(b.get("step", 0))
+            lag = max(0, max_step - step)
+            ema = b.get("step_time_ema_s")
+            wait = b.get("data_wait_fraction")
+            lost = age > self.dead_after_s
+            slow_ema = (
+                not lost
+                and len(alive) >= 2
+                and ema is not None
+                and median_ema > 0
+                and float(ema) >= self.ratio * median_ema
+            )
+            # under fully synchronous collectives the fleet is LOCKSTEP: the
+            # slow host drags everyone, so step counters and wall-clock EMAs
+            # equalize fleet-wide and neither lag nor the ratio check can
+            # single it out — the distinguishing signal is where the time
+            # goes, i.e. a data-wait share far above the fleet's
+            slow_wait = (
+                not lost
+                and len(alive) >= 2
+                and wait is not None
+                and float(wait) >= 0.3
+                and float(wait) >= 2.0 * max(median_wait, 0.05)
+            )
+            straggler = not lost and len(alive) >= 2 and (
+                lag >= self.lag_steps or slow_ema or slow_wait
+            )
+            status = self.LOST if lost else self.STRAGGLER if straggler else self.OK
+            symptom = self._symptom(wait, median_wait, slow_ema)
+            prev = self._status.get(h, self.OK)
+            if status != prev:
+                if status == self.LOST:
+                    events.append(
+                        (
+                            "fleet_host_lost",
+                            {"host_id": h, "last_step": step, "heartbeat_age_s": round(age, 3)},
+                        )
+                    )
+                elif prev == self.LOST:
+                    events.append(
+                        (
+                            "fleet_host_rejoined",
+                            {"host_id": h, "step": step, "lost_for_s": round(age, 3)},
+                        )
+                    )
+                if status == self.STRAGGLER:
+                    events.append(
+                        (
+                            "fleet_straggler",
+                            {
+                                "host_id": h,
+                                "step": step,
+                                "lag": lag,
+                                "symptom": symptom,
+                                "step_time_ema_s": ema,
+                                "fleet_median_step_s": round(median_ema, 6),
+                                "data_wait_fraction": wait,
+                            },
+                        )
+                    )
+            self._status[h] = status
+            hosts[h] = {
+                "status": status,
+                "step": step,
+                "lag": lag,
+                "heartbeat_age_s": round(age, 3),
+                "step_time_ema_s": ema,
+                "data_wait_fraction": wait,
+                "shard_retries": int(b.get("shard_retries", 0) or 0),
+                "shard_quarantines": int(b.get("shard_quarantines", 0) or 0),
+                "sentinel_bad_steps": int(b.get("sentinel_bad_steps", 0) or 0),
+                "symptom": symptom if status != self.OK else None,
+            }
+            # gauges (string label values per Prometheus convention)
+            hs = str(h)
+            for field, fam in self._g_beacon:
+                v = b.get(field)
+                if v is not None:
+                    fam.labels(host=hs).set(float(v))
+            self._g_lag.labels(host=hs).set(lag)
+            self._g_age.labels(host=hs).set(age)
+            self._g_straggler.labels(host=hs).set(1 if status == self.STRAGGLER else 0)
+            self._g_up.labels(host=hs).set(0 if lost else 1)
+
+        self._g_alive.set(len(alive))
+        if self.expected_hosts is not None:
+            self._g_expected.set(self.expected_hosts)
+        missing = (
+            sorted(set(range(self.expected_hosts)) - set(beacons))
+            if self.expected_hosts is not None
+            else []
+        )
+        summary = {
+            "hosts": hosts,
+            "alive": len(alive),
+            "expected": self.expected_hosts,
+            "max_step": max_step,
+            "missing": missing,
+            "stragglers": [h for h, s in hosts.items() if s["status"] == self.STRAGGLER],
+            "lost": [h for h, s in hosts.items() if s["status"] == self.LOST],
+        }
+        summary["degraded"] = bool(summary["stragglers"] or summary["lost"])
+        self._summary = summary
+        self._last_scan = time.monotonic()
+        # events OUTSIDE per-host loop state but inside the lock: transition
+        # order within one scan is deterministic; the journal has its own lock
+        if self.on_event is not None:
+            for etype, payload in events:
+                try:
+                    self.on_event(etype, **payload)
+                except Exception:  # noqa: BLE001 — health must not kill the run
+                    pass
+        return summary
+
+    @staticmethod
+    def _symptom(wait, median_wait: float, slow_ema: bool) -> str:
+        """Dominant-symptom attribution for an unhealthy host: a data-starved
+        host shows a wait fraction far above the fleet's; otherwise blame the
+        step-time ratio if that's what tripped, else plain step lag."""
+        if wait is not None and float(wait) >= 0.3 and float(wait) >= 2.0 * max(
+            median_wait, 0.05
+        ):
+            return "data_wait"
+        if slow_ema:
+            return "step_time"
+        return "step_lag"
+
+    def _fresh_summary(self, max_age_s: float = 1.0) -> dict:
+        with self._lock:
+            if time.monotonic() - self._last_scan > max_age_s:
+                return self._scan_locked(time.time())
+            return self._summary
+
+    # -------------------------------------------------- /healthz integration
+
+    def degraded(self) -> bool:
+        """Shaped for :meth:`HealthState.degraded_when`: any straggling or
+        lost host. Rescans when the cached verdict is stale, so a /healthz
+        poll flips within one heartbeat window of a host dying even while
+        the train thread is wedged in a collective."""
+        return bool(self._fresh_summary().get("degraded"))
+
+    def summary(self) -> dict:
+        """Shaped for ``HealthState.probe("fleet", ...)``: the per-host
+        health table under ``info.fleet`` in the /healthz body."""
+        return self._fresh_summary()

@@ -1,6 +1,7 @@
 """Crash flight recorder: a ring buffer of recent step diagnostics + events,
 dumped to ``<workdir>/flightrec-<ts>-<reason>.json`` when something goes
-wrong.
+wrong (``flightrec-h<i>-...`` on non-zero hosts of a multi-process run —
+every host records and dumps its own black box into the shared run dir).
 
 The journal (``obs/journal.py``) records *log-cadence* snapshots durably;
 the flight recorder keeps the last N *per-step* diagnostics in memory —
@@ -53,8 +54,13 @@ class FlightRecorder:
         *,
         capacity: int = 256,
         event_capacity: int = 128,
+        host: int = 0,
     ):
         self.workdir = Path(workdir)
+        # non-zero hosts tag their dump filenames (flightrec-h<i>-...) so a
+        # pod-wide incident leaves one attributable black box per host in the
+        # shared run dir; host 0 keeps the historical name unchanged
+        self.host = int(host)
         self._lock = threading.Lock()
         self._steps: deque = deque(maxlen=max(1, int(capacity)))
         self._events: deque = deque(maxlen=max(1, int(event_capacity)))
@@ -97,9 +103,11 @@ class FlightRecorder:
             seq = self._dump_seq
         self.workdir.mkdir(parents=True, exist_ok=True)
         ts = time.strftime("%Y%m%d-%H%M%S")
-        path = self.workdir / f"flightrec-{ts}-{seq:02d}-{reason}.json"
+        tag = "" if self.host == 0 else f"h{self.host}-"
+        path = self.workdir / f"flightrec-{tag}{ts}-{seq:02d}-{reason}.json"
         payload = {
             "reason": reason,
+            "host": self.host,
             "written_at": round(time.time(), 3),
             "steps": _sanitize(steps),
             "events": _sanitize(events),

@@ -29,17 +29,28 @@ Crash-safety contract:
 - rotation starts a new numbered segment (``journal-00001.jsonl`` …) and
   never rewrites an old one; a restarted run opens a fresh segment, so a
   torn tail can never be appended after.
+
+Multi-host: every process writes its OWN journal — host 0 under
+``<run_dir>/journal/``, host *i* under ``<run_dir>/journal-host<i>/`` —
+and every row carries a ``host`` field (the writer). There is no shared
+write path to coordinate; :func:`read_merged_journal` merges the per-host
+streams offline, ordered by ``(ts, host, seq)`` and tolerant of a torn
+tail in any one host's segment (a host SIGKILLed mid-line costs that line,
+nothing else).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
+import threading
 import time
 from pathlib import Path
 
 _SEGMENT_PREFIX = "journal-"
 _SEGMENT_SUFFIX = ".jsonl"
+_HOST_DIR_RE = re.compile(r"^journal-host(\d+)$")
 
 
 def _json_default(obj):
@@ -74,8 +85,11 @@ def _sanitize(value):
 class RunJournal:
     """Writer half: fsync-per-line JSONL segments with size-based rotation.
 
-    Not thread-safe by design — events come from the single train loop
-    thread at log cadence (the fsync is the cost ceiling, not a lock).
+    Writes are serialized by one lock — the train loop owns the cadence,
+    but the fleet aggregator emits transition events from the exporter's
+    scrape thread (the fsync is the cost ceiling, not the lock). With
+    ``host`` set, every record carries it so merged multi-host reads can
+    attribute rows.
     """
 
     def __init__(
@@ -85,12 +99,15 @@ class RunJournal:
         max_bytes: int = 4 * 1024 * 1024,
         keep: int = 64,
         fsync: bool = True,
+        host: int | None = None,
     ):
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_bytes = int(max_bytes)
         self.keep = int(keep)
         self.fsync = bool(fsync)
+        self.host = None if host is None else int(host)
+        self._lock = threading.Lock()
         self._seq = 0
         # a restarted run continues in a NEW segment after the highest
         # existing index — an old torn tail stays torn, ordering by
@@ -118,23 +135,26 @@ class RunJournal:
 
     def event(self, etype: str, **fields) -> dict:
         """Append one event; returns the record as written (post-sanitize)."""
-        rec = {
-            "ts": round(time.time(), 3),
-            "seq": self._seq,
-            "type": etype,
-            **_sanitize(fields),
-        }
-        line = json.dumps(
-            rec, default=_json_default, separators=(",", ":"), allow_nan=False
-        )
-        self._file.write(line + "\n")
-        self._file.flush()
-        if self.fsync:
-            os.fsync(self._file.fileno())
-        self._seq += 1
-        if self._file.tell() >= self.max_bytes:
-            self._rotate()
-        return rec
+        with self._lock:
+            rec = {
+                "ts": round(time.time(), 3),
+                "seq": self._seq,
+                "type": etype,
+            }
+            if self.host is not None:
+                rec["host"] = self.host
+            rec.update(_sanitize(fields))
+            line = json.dumps(
+                rec, default=_json_default, separators=(",", ":"), allow_nan=False
+            )
+            self._file.write(line + "\n")
+            self._file.flush()
+            if self.fsync:
+                os.fsync(self._file.fileno())
+            self._seq += 1
+            if self._file.tell() >= self.max_bytes:
+                self._rotate()
+            return rec
 
     def _rotate(self) -> None:
         self._file.close()
@@ -149,11 +169,12 @@ class RunJournal:
                 pass
 
     def close(self) -> None:
-        if not self._file.closed:
-            self._file.flush()
-            if self.fsync:
-                os.fsync(self._file.fileno())
-            self._file.close()
+        with self._lock:
+            if not self._file.closed:
+                self._file.flush()
+                if self.fsync:
+                    os.fsync(self._file.fileno())
+                self._file.close()
 
     def __enter__(self) -> "RunJournal":
         return self
@@ -205,6 +226,56 @@ def read_journal(path: str | Path) -> list[dict]:
                 continue  # torn tail or damaged line — skip, keep reading
             if isinstance(rec, dict):
                 events.append(rec)
+    return events
+
+
+def _host_of_journal_dir(d: Path) -> int:
+    m = _HOST_DIR_RE.match(d.name)
+    return int(m.group(1)) if m else 0
+
+
+def read_merged_journal(path: str | Path) -> list[dict]:
+    """Merged multi-host read: every parseable event from host 0's
+    ``journal/`` AND every ``journal-host<i>/`` under a run dir, ordered by
+    ``(ts, host, seq)``. Rows missing a ``host`` field (pre-multi-host
+    journals, hand-built fixtures) inherit the host index encoded in their
+    directory name (``journal/`` → 0), so legacy journals read identically.
+
+    Accepts the same inputs as :func:`read_journal` — a run dir, one journal
+    dir, or one segment file — and degrades to exactly its behavior (plus
+    the ordering pass) when there is only one host's journal to read. Torn
+    lines are per-segment, so one host dying mid-write never hides another
+    host's rows. Raises ``FileNotFoundError`` when no journal exists at all.
+    """
+    p = Path(path)
+    dirs: list[Path] = []
+    if p.is_dir() and not list(p.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}")):
+        # a run dir: collect host-0's journal/ plus every journal-host<i>/
+        cand = [p / "journal"] + sorted(
+            (d for d in p.glob("journal-host*") if d.is_dir()),
+            key=_host_of_journal_dir,
+        )
+        dirs = [
+            d
+            for d in cand
+            if d.is_dir() and list(d.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}"))
+        ]
+    if not dirs:
+        # single file / single journal dir → read_journal's resolution rules
+        events = read_journal(p)
+        inferred = _host_of_journal_dir(p) if p.is_dir() else 0
+        for e in events:
+            e.setdefault("host", inferred)
+    else:
+        events = []
+        for d in dirs:
+            h = _host_of_journal_dir(d)
+            for e in read_journal(d):
+                e.setdefault("host", h)
+                events.append(e)
+    events.sort(
+        key=lambda e: (e.get("ts", 0.0), e.get("host", 0), e.get("seq", 0))
+    )
     return events
 
 

@@ -167,6 +167,60 @@ def run_leg(shards: str) -> dict:
     return {"losses": losses, "val": val, "cursor": cursor}
 
 
+def fleet_leg(outdir: str) -> dict:
+    """2-process fleet-health protocol over a REAL shared run dir: every
+    process writes its beacon + its own journal segment dir; after an
+    allgather barrier guarantees both are on disk, host 0's aggregator must
+    call host 1 (written 3 steps behind, data-wait heavy) a data-wait
+    straggler, and the merged journal reader must see both hosts' rows."""
+    import jax
+    from jax.experimental.multihost_utils import process_allgather
+
+    from jumbo_mae_tpu_tpu.obs.fleet import FleetAggregator, HostBeacon
+    from jumbo_mae_tpu_tpu.obs.journal import RunJournal, read_merged_journal
+    from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+
+    n, pid = jax.process_count(), jax.process_index()
+    run_dir = os.path.join(outdir, "fleet_run")
+    step = 20 - 3 * pid
+    beacon = HostBeacon(os.path.join(run_dir, "fleet"), host=pid)
+    beacon.write(
+        step=step,
+        step_time_ema_s=0.1 * (1 + pid),
+        data_wait_fraction=0.05 + 0.55 * pid,
+    )
+    jdir = os.path.join(
+        run_dir, "journal" if pid == 0 else f"journal-host{pid}"
+    )
+    with RunJournal(jdir, host=pid) as journal:
+        journal.event("step", step=step)
+    process_allgather(np.asarray([pid]))  # barrier: all beacons+rows landed
+
+    out: dict = {"beacon_step": step}
+    if pid == 0:
+        events: list[dict] = []
+        agg = FleetAggregator(
+            os.path.join(run_dir, "fleet"),
+            expected_hosts=n,
+            lag_steps=2,
+            registry=MetricsRegistry(),
+            on_event=lambda etype, **p: events.append({"type": etype, **p}),
+        )
+        summary = agg.scan()
+        out["summary_hosts"] = {
+            str(h): s["status"] for h, s in summary["hosts"].items()
+        }
+        out["stragglers"] = summary["stragglers"]
+        out["events"] = events
+        out["merged_step_hosts"] = sorted(
+            e.get("host")
+            for e in read_merged_journal(run_dir)
+            if e.get("type") == "step"
+        )
+    process_allgather(np.asarray([pid]))  # host 1 outlives the scan
+    return out
+
+
 def build_fsdp(mesh=None):
     """(state, state_sharding, train_step, mesh) on a data=2 × fsdp=4 mesh
     over 8 global devices — identical in every topology (the single-process
@@ -257,6 +311,8 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU collectives need gloo, set before any backend touch
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         f"127.0.0.1:{port}", num_processes=n, process_id=pid
     )
@@ -265,6 +321,7 @@ def main():
         result = run_leg_fsdp(os.path.join(outdir, "ckpt"))
     else:
         result = run_leg(shards)
+        result["fleet"] = fleet_leg(outdir)
     result |= {"pid": pid, "n_devices": len(jax.devices())}
     with open(os.path.join(outdir, f"proc{pid}.json"), "w") as f:
         json.dump(result, f)

@@ -1,0 +1,512 @@
+"""Fleet observability: beacons, the host-0 aggregator's status machine,
+multi-host journal merging, host-selected fault injection, host-tagged
+flight records, and the two offline doctors over fleet artifacts.
+
+Covers the PR-11 acceptance surface without any networking — the protocol's
+shared medium is a plain directory, so every behavior (straggler by lag,
+straggler by step-time ratio with data-wait attribution, lost/rejoined
+transitions, /healthz degradation, torn-line tolerance in a merged read) is
+driven by synthetic beacon/journal files plus one short real train run.
+"""
+
+import json
+import math
+from pathlib import Path
+
+import pytest
+
+from jumbo_mae_tpu_tpu.faults import (
+    clear_plan,
+    current_host_index,
+    fault_point,
+    install_plan,
+    set_host_index,
+)
+from jumbo_mae_tpu_tpu.obs.fleet import FleetAggregator, HostBeacon, read_beacons
+from jumbo_mae_tpu_tpu.obs.flightrec import FlightRecorder
+from jumbo_mae_tpu_tpu.obs.journal import RunJournal, read_merged_journal
+from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+
+RECIPES = Path(__file__).resolve().parent.parent / "recipes"
+
+T0 = 1_700_000_000.0  # fixed fleet epoch: every scan passes `now` explicitly
+
+
+# ----------------------------------------------------------------- beacons
+
+
+class TestHostBeacon:
+    def test_write_read_roundtrip(self, tmp_path):
+        b = HostBeacon(tmp_path, host=3)
+        payload = b.write(
+            step=17,
+            step_time_ema_s=0.25,
+            data_wait_fraction=0.1,
+            shard_retries=2,
+            sentinel_bad_steps=1,
+            now=T0,
+        )
+        assert payload["heartbeat"] == T0
+        got = read_beacons(tmp_path)
+        assert set(got) == {3}
+        assert got[3]["step"] == 17
+        assert got[3]["step_time_ema_s"] == 0.25
+        assert got[3]["shard_retries"] == 2
+        assert got[3]["host"] == 3 and got[3]["pid"] == b.pid
+
+    def test_rewrite_is_atomic_no_tmp_left(self, tmp_path):
+        b = HostBeacon(tmp_path, host=0)
+        for step in range(5):
+            b.write(step=step, now=T0 + step)
+        assert b.writes == 5
+        # only the beacon itself remains — the tmp was renamed away
+        assert [p.name for p in tmp_path.iterdir()] == ["host-0.json"]
+        assert read_beacons(tmp_path)[0]["step"] == 4
+
+    def test_corrupt_and_foreign_files_skipped(self, tmp_path):
+        HostBeacon(tmp_path, host=0).write(step=1, now=T0)
+        (tmp_path / "host-1.json").write_text('{"step": 5, "heart')  # torn copy
+        (tmp_path / "host-x.json").write_text("{}")  # unparseable index
+        (tmp_path / "host-2.json").write_text("[1, 2]")  # not a dict
+        got = read_beacons(tmp_path)
+        assert set(got) == {0}
+
+    def test_missing_dir_reads_empty(self, tmp_path):
+        assert read_beacons(tmp_path / "nope") == {}
+
+
+# -------------------------------------------------------------- aggregator
+
+
+def _fleet(tmp_path, **kw):
+    """Aggregator over tmp_path with an isolated registry + captured events."""
+    events: list[dict] = []
+    kw.setdefault("registry", MetricsRegistry())
+    agg = FleetAggregator(
+        tmp_path,
+        on_event=lambda etype, **p: events.append({"type": etype, **p}),
+        **kw,
+    )
+    return agg, events
+
+
+class TestAggregator:
+    def test_straggler_by_step_lag(self, tmp_path):
+        for h, step in ((0, 10), (1, 10), (2, 7)):
+            HostBeacon(tmp_path, host=h).write(step=step, now=T0)
+        reg = MetricsRegistry()
+        agg, events = _fleet(tmp_path, expected_hosts=3, lag_steps=2, registry=reg)
+        s = agg.scan(now=T0 + 1)
+        assert s["alive"] == 3 and s["max_step"] == 10 and s["missing"] == []
+        assert s["stragglers"] == [2] and s["lost"] == []
+        assert s["hosts"][2]["status"] == "straggler"
+        assert s["hosts"][2]["lag"] == 3
+        assert s["degraded"] is True
+        # gauges carry per-host values with string labels
+        assert reg.gauge("fleet_step_lag", labels=("host",)).labels(host="2").value == 3
+        assert reg.gauge("fleet_step", labels=("host",)).labels(host="0").value == 10
+        assert reg.gauge("fleet_straggler", labels=("host",)).labels(host="2").value == 1
+        assert reg.gauge("fleet_hosts_alive").value == 3
+        # the transition event fired exactly once, not once per scan
+        assert [e["type"] for e in events] == ["fleet_straggler"]
+        assert events[0]["host_id"] == 2 and events[0]["lag"] == 3
+        agg.scan(now=T0 + 2)
+        assert len(events) == 1
+
+    def test_straggler_by_ema_with_data_wait_symptom(self, tmp_path):
+        HostBeacon(tmp_path, host=0).write(
+            step=10, step_time_ema_s=0.1, data_wait_fraction=0.02, now=T0
+        )
+        HostBeacon(tmp_path, host=1).write(
+            step=10, step_time_ema_s=0.4, data_wait_fraction=0.7, now=T0
+        )
+        agg, events = _fleet(tmp_path, expected_hosts=2, ratio=1.5)
+        s = agg.scan(now=T0 + 1)
+        # no step lag at all — the EMA ratio alone trips the straggler flag,
+        # and the outsized wait fraction attributes it to data starvation
+        assert s["stragglers"] == [1]
+        assert events[0]["type"] == "fleet_straggler"
+        assert events[0]["symptom"] == "data_wait"
+        assert s["hosts"][1]["symptom"] == "data_wait"
+
+    def test_lockstep_fleet_straggler_by_data_wait_alone(self, tmp_path):
+        # a fully synchronous fleet is lockstep: the slow host drags every
+        # step, so steps AND wall-clock EMAs equalize fleet-wide — the only
+        # distinguishing signal left is the data-wait share (this is exactly
+        # what the 2-process CPU chaos smoke observes)
+        HostBeacon(tmp_path, host=0).write(
+            step=80, step_time_ema_s=0.7, data_wait_fraction=0.01, now=T0
+        )
+        HostBeacon(tmp_path, host=1).write(
+            step=80, step_time_ema_s=0.7, data_wait_fraction=0.45, now=T0
+        )
+        agg, events = _fleet(tmp_path, expected_hosts=2)
+        s = agg.scan(now=T0 + 1)
+        assert s["stragglers"] == [1]
+        assert s["hosts"][1]["symptom"] == "data_wait"
+        assert events[0]["type"] == "fleet_straggler"
+        assert events[0]["symptom"] == "data_wait"
+
+    def test_single_host_never_straggles(self, tmp_path):
+        HostBeacon(tmp_path, host=0).write(step=3, now=T0)
+        agg, events = _fleet(tmp_path, expected_hosts=1)
+        s = agg.scan(now=T0 + 1)
+        assert s["stragglers"] == [] and s["degraded"] is False
+
+    def test_lost_then_rejoined(self, tmp_path):
+        HostBeacon(tmp_path, host=0).write(step=50, now=T0 + 100)
+        HostBeacon(tmp_path, host=1).write(step=48, now=T0)
+        agg, events = _fleet(tmp_path, expected_hosts=2, dead_after_s=60.0)
+        s = agg.scan(now=T0 + 101)
+        assert s["lost"] == [1] and s["alive"] == 1
+        assert s["hosts"][1]["status"] == "lost"
+        assert s["degraded"] is True
+        assert [e["type"] for e in events] == ["fleet_host_lost"]
+        assert events[0]["host_id"] == 1 and events[0]["last_step"] == 48
+        # a fresh beacon (restarted process) flips it back with a rejoin event
+        HostBeacon(tmp_path, host=1).write(step=49, now=T0 + 102)
+        s = agg.scan(now=T0 + 103)
+        assert s["lost"] == [] and s["alive"] == 2
+        assert [e["type"] for e in events][-1] == "fleet_host_rejoined"
+        assert events[-1]["host_id"] == 1
+
+    def test_missing_host_reported_without_lost_event(self, tmp_path):
+        HostBeacon(tmp_path, host=0).write(step=5, now=T0)
+        agg, events = _fleet(tmp_path, expected_hosts=4)
+        s = agg.scan(now=T0 + 1)
+        # hosts that never beaconed are *missing*, not lost — no heartbeat
+        # history exists to age, so no transition event fires
+        assert s["missing"] == [1, 2, 3]
+        assert s["lost"] == [] and events == []
+
+    def test_degraded_rescans_stale_summary(self, tmp_path):
+        import time as _time
+
+        HostBeacon(tmp_path, host=0).write(step=5)
+        HostBeacon(tmp_path, host=1).write(step=5)
+        agg, _ = _fleet(tmp_path, expected_hosts=2, dead_after_s=60.0)
+        assert agg.degraded() is False  # both hearts fresh (real clock)
+        # hand-write a stale heartbeat: host 1 died 120s "ago"
+        p = tmp_path / "host-1.json"
+        rec = json.loads(p.read_text())
+        rec["heartbeat"] = _time.time() - 120.0
+        p.write_text(json.dumps(rec))
+        agg._last_scan = 0.0  # force the freshness check to rescan
+        assert agg.degraded() is True
+        assert agg.summary()["lost"] == [1]
+
+
+# --------------------------------------------------- multi-host journal merge
+
+
+class TestMergedJournal:
+    def _write(self, d, host, rows):
+        with RunJournal(d, host=host) as j:
+            for ts, etype, fields in rows:
+                rec = j.event(etype, **fields)
+                # pin ts deterministically (event() stamps real time)
+                self._patch_ts(j.path, rec["seq"], ts)
+
+    @staticmethod
+    def _patch_ts(path, seq, ts):
+        lines = path.read_text().splitlines()
+        out = []
+        for ln in lines:
+            rec = json.loads(ln)
+            if rec.get("seq") == seq:
+                rec["ts"] = ts
+            out.append(json.dumps(rec, separators=(",", ":")))
+        path.write_text("\n".join(out) + "\n")
+
+    def test_merge_orders_by_ts_host_seq(self, tmp_path):
+        self._write(
+            tmp_path / "journal", 0,
+            [(1.0, "run_start", {}), (3.0, "step", {"step": 2})],
+        )
+        self._write(
+            tmp_path / "journal-host1", 1,
+            [(1.0, "run_start", {}), (2.0, "step", {"step": 1})],
+        )
+        evs = read_merged_journal(tmp_path)
+        assert [(e["ts"], e["host"], e["type"]) for e in evs] == [
+            (1.0, 0, "run_start"),
+            (1.0, 1, "run_start"),
+            (2.0, 1, "step"),
+            (3.0, 0, "step"),
+        ]
+
+    def test_torn_line_in_one_host_costs_only_that_line(self, tmp_path):
+        self._write(tmp_path / "journal", 0, [(1.0, "run_start", {})])
+        self._write(tmp_path / "journal-host1", 1, [(1.5, "run_start", {})])
+        seg = sorted((tmp_path / "journal-host1").glob("journal-*.jsonl"))[-1]
+        with open(seg, "a") as f:
+            f.write('{"ts": 2.0, "seq": 1, "type": "step", "ho')  # SIGKILL
+        evs = read_merged_journal(tmp_path)
+        assert [(e["host"], e["type"]) for e in evs] == [
+            (0, "run_start"),
+            (1, "run_start"),
+        ]
+
+    def test_host_inferred_from_dir_name_for_legacy_rows(self, tmp_path):
+        # rows written WITHOUT host= (pre-multi-host journals) inherit the
+        # index encoded in the directory name on a merged read
+        self._write(tmp_path / "journal", None, [(1.0, "step", {"step": 1})])
+        self._write(
+            tmp_path / "journal-host2", None, [(2.0, "step", {"step": 1})]
+        )
+        evs = read_merged_journal(tmp_path)
+        assert [e["host"] for e in evs] == [0, 2]
+
+    def test_single_journal_dir_and_file_still_work(self, tmp_path):
+        self._write(tmp_path / "journal", 0, [(1.0, "run_start", {})])
+        assert read_merged_journal(tmp_path / "journal")[0]["host"] == 0
+        seg = sorted((tmp_path / "journal").glob("journal-*.jsonl"))[0]
+        assert read_merged_journal(seg)[0]["type"] == "run_start"
+
+    def test_missing_journal_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            read_merged_journal(tmp_path)
+
+
+# --------------------------------------------------- host-selected injection
+
+
+@pytest.fixture
+def _clean_host_identity():
+    yield
+    set_host_index(None)
+    clear_plan()
+
+
+class TestHostSelector:
+    def test_fires_only_on_matching_host(self, _clean_host_identity):
+        install_plan("data.decode:nan@host=1")
+        set_host_index(0)
+        assert fault_point("data.decode", data=1.0) == 1.0
+        set_host_index(1)
+        assert math.isnan(fault_point("data.decode", data=1.0))
+
+    def test_env_fallback_for_worker_subprocesses(
+        self, _clean_host_identity, monkeypatch
+    ):
+        # set_host_index mirrors into GRAFT_HOST; a fresh resolution (as in a
+        # spawned data worker that never called set_host_index) reads it back
+        set_host_index(3)
+        import os
+
+        assert os.environ["GRAFT_HOST"] == "3"
+        set_host_index(None)  # forget the pin, keep resolving lazily
+        monkeypatch.setenv("GRAFT_HOST", "1")
+        install_plan("data.decode:nan@host=1")
+        assert math.isnan(fault_point("data.decode", data=1.0))
+
+    def test_combines_with_other_selectors(self, _clean_host_identity):
+        set_host_index(1)
+        install_plan("data.decode:nan@host=1,n<1")
+        assert math.isnan(fault_point("data.decode", data=1.0))
+        assert fault_point("data.decode", data=1.0) == 1.0  # n<1 exhausted
+
+
+# -------------------------------------------------- host-tagged flight rec
+
+
+class TestFlightRecorderHostTag:
+    def test_nonzero_host_tags_filename_and_payload(self, tmp_path):
+        fr = FlightRecorder(tmp_path, host=2)
+        fr.record_step(1, {"loss": 1.0})
+        path = fr.dump("sigterm")
+        assert path.name.startswith("flightrec-h2-")
+        assert json.loads(path.read_text())["host"] == 2
+
+    def test_host_zero_keeps_legacy_names(self, tmp_path):
+        fr = FlightRecorder(tmp_path, host=0)
+        path = fr.dump("x")
+        assert path.name.startswith("flightrec-") and "h0" not in path.name
+        assert json.loads(path.read_text())["host"] == 0
+
+
+# ------------------------------------------------------------ fleet doctor
+
+
+def _incident_fleet_dir(tmp_path: Path) -> Path:
+    """Run dir with host 1 straggling (data-wait) and journaled transitions."""
+    fleet = tmp_path / "fleet"
+    HostBeacon(fleet, host=0).write(
+        step=40, step_time_ema_s=0.1, data_wait_fraction=0.03, now=T0 + 40
+    )
+    HostBeacon(fleet, host=1).write(
+        step=30, step_time_ema_s=0.35, data_wait_fraction=0.8, now=T0 + 40
+    )
+    with RunJournal(tmp_path / "journal", host=0) as j:
+        j.event("run_start", config={}, env={}, start_step=0)
+        j.event(
+            "fleet_straggler",
+            host_id=1,
+            step=32,
+            lag=4,
+            symptom="data_wait",
+            step_time_ema_s=0.35,
+            fleet_median_step_s=0.1,
+            data_wait_fraction=0.8,
+        )
+        j.event("shutdown", reason="completed", step=40)
+    return tmp_path
+
+
+class TestFleetDoctor:
+    def test_exit_zero_and_names_straggler(self, tmp_path, capsys):
+        import tools.fleet_doctor as doctor
+
+        run_dir = _incident_fleet_dir(tmp_path)
+        assert doctor.main([str(run_dir)]) == 0
+        report = capsys.readouterr().out
+        assert "straggler: **host 1**" in report
+        assert "data-wait-dominant" in report
+        assert "| 1 | straggler |" in report
+        assert "fleet_straggler" in report  # timeline row
+
+    def test_lost_host_named(self, tmp_path, capsys):
+        fleet = tmp_path / "fleet"
+        HostBeacon(fleet, host=0).write(step=100, now=T0 + 200)
+        HostBeacon(fleet, host=1).write(step=80, now=T0)  # 200s stale
+        import tools.fleet_doctor as doctor
+
+        assert doctor.main([str(tmp_path), "--dead-after-s", "60"]) == 0
+        report = capsys.readouterr().out
+        assert "lost: **host 1**" in report
+        assert "last beacon at step 80" in report
+
+    def test_healthy_fleet(self, tmp_path, capsys):
+        fleet = tmp_path / "fleet"
+        for h in (0, 1):
+            HostBeacon(fleet, host=h).write(step=10, now=T0)
+        import tools.fleet_doctor as doctor
+
+        assert doctor.main([str(tmp_path), "--out", str(tmp_path / "f.md")]) == 0
+        assert "fleet healthy" in (tmp_path / "f.md").read_text()
+
+    def test_exit_two_without_beacons(self, tmp_path):
+        import tools.fleet_doctor as doctor
+
+        assert doctor.main([str(tmp_path)]) == 2
+
+
+# ------------------------------------------- run doctor over merged journals
+
+
+def _merged_run_dir(tmp_path: Path) -> Path:
+    """Both hosts journal the same 2 step windows; only host 0's may count."""
+    for host in (0, 1):
+        d = tmp_path / ("journal" if host == 0 else f"journal-host{host}")
+        with RunJournal(d, host=host) as j:
+            j.event("run_start", config={}, env={}, start_step=0)
+            for s in (2, 4):
+                j.event(
+                    "step",
+                    step=s,
+                    metrics={
+                        "train/loss": 1.0,
+                        "perf/images_per_sec": 100.0 * (1 + host),
+                    },
+                    data_wait_fraction=0.05,
+                )
+            j.event("shutdown", reason="completed", step=4)
+    with RunJournal(tmp_path / "journal", host=0) as j:
+        j.event("fleet_straggler", host_id=1, step=3, lag=2, symptom="data_wait")
+        j.event("fleet_host_lost", host_id=1, last_step=4, heartbeat_age_s=70.0)
+    return tmp_path
+
+
+class TestRunDoctorMerged:
+    def test_no_double_counted_steps_and_fleet_timeline(self, tmp_path, capsys):
+        import tools.run_doctor as doctor
+
+        run_dir = _merged_run_dir(tmp_path)
+        assert doctor.main([str(run_dir)]) == 0
+        report = capsys.readouterr().out
+        # host 0's 2 windows drive throughput — 4 windows would mean host 1's
+        # rows were double-counted (and "best 200" would leak host 1's rate)
+        assert "images/sec across 2 windows" in report
+        assert "best 100" in report
+        # fleet transitions render in the timeline with the affected host
+        assert "fleet_straggler" in report
+        assert "host 1 at step 3, lag 2" in report
+        assert "fleet_host_lost" in report
+        assert "merged journal across 2 hosts" in report
+
+    def test_single_host_journal_unchanged(self, tmp_path, capsys):
+        import tools.run_doctor as doctor
+
+        with RunJournal(tmp_path / "journal", host=0) as j:
+            j.event("run_start", config={}, env={}, start_step=0)
+            j.event("step", step=5, metrics={"train/loss": 0.9})
+            j.event("shutdown", reason="completed", step=5)
+        assert doctor.main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "no incidents recorded" in out
+        assert "merged journal" not in out
+
+
+# ------------------------------------------------- /healthz degraded compose
+
+
+def test_healthz_degraded_predicates_compose():
+    from jumbo_mae_tpu_tpu.obs.exporter import HealthState
+
+    h = HealthState()
+    h.set_ready(True)
+    flags = {"a": False, "b": False}
+    h.degraded_when(lambda: flags["a"])
+    h.degraded_when(lambda: flags["b"])  # must OR, not replace
+    assert h.report()[1]["degraded"] is False
+    flags["b"] = True
+    assert h.report()[1]["degraded"] is True
+    flags["b"] = False
+    flags["a"] = True
+    assert h.report()[1]["degraded"] is True
+
+    def boom():
+        raise RuntimeError("probe died")
+
+    h2 = HealthState()
+    h2.set_ready(True)
+    h2.degraded_when(boom)
+    assert "probe error" in str(h2.report()[1]["degraded"])
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def test_train_run_writes_beacon_and_fleet_doctor_reads_it(tmp_path):
+    """Acceptance: a short CPU run (single host) leaves a fresh beacon under
+    <run_dir>/fleet/ with real step/step-time/data-wait numbers, and
+    fleet_doctor exits 0 on the run dir calling the 1-host fleet healthy."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+    from jumbo_mae_tpu_tpu.config import load_config
+
+    import tools.fleet_doctor as doctor
+
+    cfg = load_config(
+        RECIPES / "smoke_cpu.yaml",
+        [
+            f"run.output_dir={tmp_path}",
+            "run.training_steps=4",
+            "optim.training_steps=4",
+            "optim.warmup_steps=2",
+            "run.log_interval=2",
+            "run.eval_interval=4",
+            "run.sanity_eval=false",
+        ],
+    )
+    metrics = train(cfg)
+    assert math.isfinite(metrics["train/loss"])
+    run_dir = tmp_path / "smoke_cpu"
+    beacons = read_beacons(run_dir / "fleet")
+    assert set(beacons) == {0}
+    b = beacons[0]
+    assert b["step"] == 4
+    assert b["step_time_ema_s"] > 0
+    assert 0.0 <= b["data_wait_fraction"] <= 1.0
+    assert b["sentinel_bad_steps"] == 0
+    assert doctor.main([str(run_dir), "--out", str(tmp_path / "fleet.md")]) == 0
+    assert "fleet healthy" in (tmp_path / "fleet.md").read_text()

@@ -143,6 +143,19 @@ def test_two_process_train_and_eval_match_single_process(shards, tmp_path):
         assert c["all"] == [[[0, 10]], [[1, 11]]]
         assert c["mismatch_dropped"] is True
 
+    # fleet protocol over the REAL shared run dir: host 1 wrote its beacon
+    # 3 steps behind with a heavy data-wait fraction → host 0's aggregator
+    # flags it a data-wait straggler, and the merged journal reader returns
+    # both hosts' rows
+    fleet = results[0]["fleet"]
+    assert fleet["summary_hosts"] == {"0": "ok", "1": "straggler"}
+    assert fleet["stragglers"] == [1]
+    strag = [e for e in fleet["events"] if e["type"] == "fleet_straggler"]
+    assert len(strag) == 1
+    assert strag[0]["host_id"] == 1 and strag[0]["symptom"] == "data_wait"
+    assert fleet["merged_step_hosts"] == [0, 1]
+    assert results[1]["fleet"]["beacon_step"] == 17
+
     # single-process reference on the same global batches + full valid set
     ref = worker.run_leg(shards)
     np.testing.assert_allclose(

@@ -527,7 +527,12 @@ def test_healthstate_degraded_when_does_not_flip_ok():
     ok, body = h.report()
     assert ok, "degraded must not flip the 503 readiness verdict"
     assert body["degraded"] is True
+    # predicates compose via OR: the erroring probe's message surfaces only
+    # while no other predicate already reports a real degraded verdict
     h.degraded_when(lambda: 1 / 0)
+    ok, body = h.report()
+    assert ok and body["degraded"] is True
+    flag["v"] = False
     ok, body = h.report()
     assert ok and "probe error" in body["degraded"]
 

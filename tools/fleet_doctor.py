@@ -1,0 +1,339 @@
+#!/usr/bin/env python3
+"""Offline fleet diagnosis: beacons + merged journal → per-host health table.
+
+run_doctor explains ONE run's lifecycle; this tool explains the FLEET — which
+host dragged the pod, which host died, and why — from the crash-safe
+artifacts alone (the ``<run_dir>/fleet/`` beacon dir plus the per-host
+journal segments). No live process, no /metrics endpoint:
+
+    python tools/fleet_doctor.py runs/my_run
+    python tools/fleet_doctor.py runs/my_run --out fleet.md
+    python tools/fleet_doctor.py runs/my_run --lag-steps 2 --ratio 1.5
+
+Because the run is usually *over* when this tool runs, heartbeat ages are
+measured against the fleet-latest heartbeat, not the wall clock — a host
+killed mid-run stays "lost" in the report forever, while a clean shutdown
+(all beacons written within seconds of each other) stays healthy.
+
+The verdict names each unhealthy host and its dominant symptom
+(data-wait-dominant / compute-dominant / step-lag), cross-checked against
+the journaled ``fleet_straggler`` / ``fleet_host_lost`` transitions the
+live aggregator recorded.
+
+Exit codes: 0 = diagnosis written (healthy or not); 2 = no beacons found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from jumbo_mae_tpu_tpu.obs.doctor_common import (  # noqa: E402
+    fmt_num as _fmt_num,
+    write_report,
+)
+from jumbo_mae_tpu_tpu.obs.fleet import read_beacons  # noqa: E402
+from jumbo_mae_tpu_tpu.obs.journal import read_merged_journal  # noqa: E402
+
+# journal symptom slug → operator-readable name (the CI smoke greps these)
+SYMPTOMS = {
+    "data_wait": "data-wait-dominant",
+    "step_time": "compute-dominant",
+    "step_lag": "step-lag",
+}
+
+
+def _fleet_dir(path: Path) -> Path | None:
+    """Accept a run dir (``<run>/fleet``) or the beacon dir itself."""
+    for cand in (path / "fleet", path):
+        if cand.is_dir() and read_beacons(cand):
+            return cand
+    return None
+
+
+def analyze(
+    beacons: dict[int, dict],
+    *,
+    lag_steps: int = 2,
+    ratio: float = 1.5,
+    dead_after_s: float = 60.0,
+) -> dict:
+    """Post-mortem status machine over a beacon snapshot.
+
+    Mirrors FleetAggregator's verdicts but clocks heartbeat age off the
+    fleet-latest beacon (``now`` is unusable after the run ends) and skips
+    the transition bookkeeping — a report wants current state, not edges.
+    """
+    latest = max(
+        (float(b.get("heartbeat", 0.0)) for b in beacons.values()), default=0.0
+    )
+    alive = {
+        h: b
+        for h, b in beacons.items()
+        if latest - float(b.get("heartbeat", 0.0)) <= dead_after_s
+    }
+    max_step = max(
+        (int(b.get("step", 0)) for b in (alive or beacons).values()), default=0
+    )
+    # lower-middle medians, matching FleetAggregator (an upper median would
+    # blind the ratio check in an even fleet — see obs/fleet.py)
+    emas = sorted(
+        float(b["step_time_ema_s"])
+        for b in alive.values()
+        if b.get("step_time_ema_s")
+    )
+    median_ema = emas[(len(emas) - 1) // 2] if emas else 0.0
+    waits = sorted(
+        float(b["data_wait_fraction"])
+        for b in alive.values()
+        if b.get("data_wait_fraction") is not None
+    )
+    median_wait = waits[(len(waits) - 1) // 2] if waits else 0.0
+
+    hosts: dict[int, dict] = {}
+    for h, b in sorted(beacons.items()):
+        age = max(0.0, latest - float(b.get("heartbeat", 0.0)))
+        step = int(b.get("step", 0))
+        lag = max(0, max_step - step)
+        ema = b.get("step_time_ema_s")
+        wait = b.get("data_wait_fraction")
+        lost = age > dead_after_s
+        slow_ema = (
+            not lost
+            and len(alive) >= 2
+            and ema is not None
+            and median_ema > 0
+            and float(ema) >= ratio * median_ema
+        )
+        slow_wait = (
+            not lost
+            and len(alive) >= 2
+            and wait is not None
+            and float(wait) >= 0.3
+            and float(wait) >= 2.0 * max(median_wait, 0.05)
+        )
+        straggler = (
+            not lost
+            and len(alive) >= 2
+            and (lag >= lag_steps or slow_ema or slow_wait)
+        )
+        if wait is not None and float(wait) >= 0.3 and float(wait) >= 2.0 * max(
+            median_wait, 0.05
+        ):
+            symptom = "data_wait"
+        elif slow_ema:
+            symptom = "step_time"
+        else:
+            symptom = "step_lag"
+        hosts[h] = {
+            "status": "lost" if lost else "straggler" if straggler else "ok",
+            "step": step,
+            "lag": lag,
+            "heartbeat_age_s": round(age, 3),
+            "step_time_ema_s": ema,
+            "data_wait_fraction": wait,
+            "shard_retries": int(b.get("shard_retries", 0) or 0),
+            "shard_quarantines": int(b.get("shard_quarantines", 0) or 0),
+            "sentinel_bad_steps": int(b.get("sentinel_bad_steps", 0) or 0),
+            "symptom": symptom,
+            "hostname": b.get("hostname"),
+            "pid": b.get("pid"),
+        }
+    return {
+        "hosts": hosts,
+        "max_step": max_step,
+        "median_step_s": median_ema,
+        "median_wait": median_wait,
+    }
+
+
+def _dominant_symptom(host_id: int, hosts: dict, stragglers: list[dict]) -> str:
+    """Pick the most *informative* symptom across the journaled straggler
+    events plus the final-beacon snapshot. Precedence (not frequency):
+    data_wait > step_time > step_lag — the first straggler transition often
+    fires before the slow host's first log boundary, so it journals the
+    generic ``step_lag`` with no wait stats yet; a later event (or the final
+    beacon) that attributes the lag to data starvation supersedes it."""
+    candidates = [
+        e.get("symptom") for e in stragglers if e.get("host_id") == host_id
+    ]
+    if host_id in hosts:
+        candidates.append(hosts[host_id]["symptom"])
+    for slug in ("data_wait", "step_time", "step_lag"):
+        if slug in candidates:
+            return SYMPTOMS[slug]
+    return str(candidates[0]) if candidates else "step-lag"
+
+
+def diagnose(beacons: dict[int, dict], events: list[dict], args) -> str:
+    res = analyze(
+        beacons,
+        lag_steps=args.lag_steps,
+        ratio=args.ratio,
+        dead_after_s=args.dead_after_s,
+    )
+    hosts = res["hosts"]
+    stragglers = [e for e in events if e.get("type") == "fleet_straggler"]
+    lost_evs = [e for e in events if e.get("type") == "fleet_host_lost"]
+    rejoins = [e for e in events if e.get("type") == "fleet_host_rejoined"]
+
+    lines = ["# Fleet doctor report", ""]
+
+    # -------------------------------------------------------------- verdict
+    bad_final = {h: s for h, s in hosts.items() if s["status"] != "ok"}
+    # a host flagged straggler by the live aggregator but healthy in its
+    # final beacon (incident resolved / run ended in lockstep) still gets
+    # named — the operator asked "who dragged the run", not "who is slow now"
+    journaled_stragglers = sorted(
+        {
+            e["host_id"]
+            for e in stragglers
+            if e.get("host_id") is not None and e["host_id"] not in bad_final
+        }
+    )
+    lines += ["## Verdict", ""]
+    if not bad_final and not journaled_stragglers and not lost_evs:
+        lines.append(
+            f"- **fleet healthy**: {len(hosts)} host(s), all ok at "
+            f"step {res['max_step']}"
+        )
+    for h, s in sorted(bad_final.items()):
+        sym = _dominant_symptom(h, hosts, stragglers)
+        if s["status"] == "lost":
+            was = (
+                f"; was a {sym} straggler before it died"
+                if any(e.get("host_id") == h for e in stragglers)
+                else ""
+            )
+            lines.append(
+                f"- lost: **host {h}** — last beacon at step {s['step']}, "
+                f"heartbeat {_fmt_num(s['heartbeat_age_s'])}s behind the "
+                f"fleet-latest{was}"
+            )
+        else:
+            lines.append(
+                f"- straggler: **host {h}** — {sym} "
+                f"(lag {s['lag']}, data-wait "
+                f"{_fmt_num(s['data_wait_fraction'] or 0)}, step-time EMA "
+                f"{_fmt_num(s['step_time_ema_s'] or 0)}s vs fleet median "
+                f"{_fmt_num(res['median_step_s'])}s)"
+            )
+    for h in journaled_stragglers:
+        sym = _dominant_symptom(h, hosts, stragglers)
+        n = sum(1 for e in stragglers if e.get("host_id") == h)
+        lines.append(
+            f"- straggler: **host {h}** — {sym} "
+            f"({n} journaled straggler event(s); healthy in its final beacon)"
+        )
+    lines.append("")
+
+    # ------------------------------------------------------ per-host table
+    lines += [
+        "## Per-host health",
+        "",
+        "| host | status | step | lag | step-time EMA | data-wait | "
+        "retries | quarantines | bad steps | heartbeat age |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for h, s in sorted(hosts.items()):
+        lines.append(
+            f"| {h} | {s['status']} | {s['step']} | {s['lag']} | "
+            f"{_fmt_num(s['step_time_ema_s']) if s['step_time_ema_s'] is not None else '—'} | "
+            f"{_fmt_num(s['data_wait_fraction']) if s['data_wait_fraction'] is not None else '—'} | "
+            f"{s['shard_retries']} | {s['shard_quarantines']} | "
+            f"{s['sentinel_bad_steps']} | {_fmt_num(s['heartbeat_age_s'])}s |"
+        )
+    lines.append("")
+
+    # ------------------------------------------------------- fleet timeline
+    fleet_evs = sorted(
+        stragglers + lost_evs + rejoins, key=lambda e: e.get("ts", 0.0)
+    )
+    lines += ["## Fleet timeline", ""]
+    if not fleet_evs:
+        lines.append("(no fleet transitions journaled)")
+    else:
+        t0 = min(e.get("ts", 0.0) for e in fleet_evs)
+        for e in fleet_evs:
+            dt = e.get("ts", t0) - t0
+            etype = e["type"]
+            if etype == "fleet_straggler":
+                detail = (
+                    f"host {e.get('host_id')} at step {e.get('step')}, "
+                    f"lag {e.get('lag')}, "
+                    f"{SYMPTOMS.get(e.get('symptom'), e.get('symptom'))}"
+                )
+            elif etype == "fleet_host_lost":
+                detail = (
+                    f"host {e.get('host_id')} (last step {e.get('last_step')}, "
+                    f"heartbeat {_fmt_num(e.get('heartbeat_age_s', 0))}s stale)"
+                )
+            else:
+                detail = (
+                    f"host {e.get('host_id')} at step {e.get('step')} "
+                    f"after {_fmt_num(e.get('lost_for_s', 0))}s"
+                )
+            lines.append(f"- +{dt:8.1f}s  `{etype}`  {detail}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("path", help="run dir (or the fleet beacon dir itself)")
+    parser.add_argument(
+        "--lag-steps",
+        type=int,
+        default=2,
+        help="straggler threshold: steps behind the fleet max (default 2)",
+    )
+    parser.add_argument(
+        "--ratio",
+        type=float,
+        default=1.5,
+        help="straggler threshold: step-time EMA / fleet median (default 1.5)",
+    )
+    parser.add_argument(
+        "--dead-after-s",
+        type=float,
+        default=60.0,
+        help="lost threshold: heartbeat seconds behind fleet-latest "
+        "(default 60)",
+    )
+    parser.add_argument(
+        "--out", default=None, help="write the markdown here (default stdout)"
+    )
+    args = parser.parse_args(argv)
+
+    path = Path(args.path)
+    fleet_dir = _fleet_dir(path)
+    if fleet_dir is None:
+        print(
+            f"[fleet_doctor] no fleet beacons under {path} "
+            "(expected <run_dir>/fleet/host-*.json — run.fleet off?)",
+            file=sys.stderr,
+        )
+        return 2
+    beacons = read_beacons(fleet_dir)
+
+    # journal is optional context: a run killed before its first journal
+    # flush still gets a beacon-only report
+    run_dir = fleet_dir.parent if fleet_dir.name == "fleet" else fleet_dir
+    try:
+        events = read_merged_journal(run_dir)
+    except FileNotFoundError:
+        events = []
+
+    report = diagnose(beacons, events, args)
+    return write_report(report, args.out, tool="fleet_doctor")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,15 @@ go non-finite (the bad step window, and the first layer group whose grad
 norm blew up when per-layer-group diagnostics were on); what the grad-norm
 trend looked like before the incident; whether throughput regressed or the
 run became data-bound across log windows; and the full resilience timeline
-(checkpoints, rollbacks, shard quarantines, flight records).
+(checkpoints, rollbacks, shard quarantines, flight records, fleet
+straggler/lost/rejoined transitions).
+
+Multi-host runs are handled via the merged journal reader: per-host segments
+(`journal/` + `journal-host<i>/`) are interleaved by time, host-0 rows drive
+the step/throughput analysis (every host journals its own `step` events —
+counting them all would multiply throughput by the fleet size), and
+flight records from any host appear in the timeline tagged with their host.
+For the per-host health table, use tools/fleet_doctor.py.
 
 Exit codes: 0 = diagnosis written (healthy or not); 2 = no journal found.
 """
@@ -37,7 +45,7 @@ from jumbo_mae_tpu_tpu.obs.doctor_common import (  # noqa: E402
     spans_text,
     write_report,
 )
-from jumbo_mae_tpu_tpu.obs.journal import read_journal  # noqa: E402
+from jumbo_mae_tpu_tpu.obs.journal import read_merged_journal  # noqa: E402
 
 
 def _is_bad_loss(v) -> bool:
@@ -105,16 +113,37 @@ def _first_nonfinite_group(events: list[dict], flight: dict | None) -> str | Non
     return None
 
 
+def _host_of(e: dict) -> int:
+    try:
+        return int(e.get("host", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _fmt_host(host_id) -> str:
+    return f"host {host_id}" if host_id is not None else "host ?"
+
+
 def diagnose(events: list[dict], flight: dict | None = None) -> str:
     """Render the markdown diagnosis for one run's journal events."""
     lines: list[str] = ["# Run doctor report", ""]
-    starts = [e for e in events if e.get("type") == "run_start"]
-    steps = [e for e in events if e.get("type") == "step"]
-    shutdowns = [e for e in events if e.get("type") == "shutdown"]
-    rollbacks = [e for e in events if e.get("type") == "rollback"]
-    quarantines = [e for e in events if e.get("type") == "quarantine"]
-    ckpts = [e for e in events if e.get("type") == "checkpoint_save"]
+    # A merged multi-host journal repeats the lifecycle per host (every host
+    # journals its own run_start/step/shutdown). Host-0 rows drive the
+    # single-run analysis — counting every host's `step` events would
+    # multiply throughput and rollbacks by the fleet size. Flight records
+    # and fleet transitions keep all hosts (tagged below).
+    hosts = sorted({_host_of(e) for e in events})
+    multi = len(hosts) > 1
+    h0 = [e for e in events if _host_of(e) == 0] if multi else events
+    starts = [e for e in h0 if e.get("type") == "run_start"]
+    steps = [e for e in h0 if e.get("type") == "step"]
+    shutdowns = [e for e in h0 if e.get("type") == "shutdown"]
+    rollbacks = [e for e in h0 if e.get("type") == "rollback"]
+    quarantines = [e for e in h0 if e.get("type") == "quarantine"]
+    ckpts = [e for e in h0 if e.get("type") == "checkpoint_save"]
     flights = [e for e in events if e.get("type") == "flight_record"]
+    stragglers = [e for e in events if e.get("type") == "fleet_straggler"]
+    lost = [e for e in events if e.get("type") == "fleet_host_lost"]
 
     # ---------------------------------------------------------- run summary
     if starts:
@@ -160,8 +189,25 @@ def diagnose(events: list[dict], flight: dict | None = None) -> str:
     if quarantines:
         n = sum(len(q.get("shards", [])) for q in quarantines)
         verdict.append(f"{n} shard(s) quarantined")
+    if stragglers or lost:
+        fleet_bits = []
+        if stragglers:
+            who = sorted({_fmt_host(e.get("host_id")) for e in stragglers})
+            fleet_bits.append(
+                f"{len(stragglers)} straggler event(s) ({', '.join(who)})"
+            )
+        if lost:
+            who = sorted({_fmt_host(e.get("host_id")) for e in lost})
+            fleet_bits.append(f"host(s) lost: {', '.join(who)}")
+        verdict.append("fleet: " + "; ".join(fleet_bits))
     if not verdict:
         verdict.append("no incidents recorded")
+    if multi:
+        verdict.append(
+            f"merged journal across {len(hosts)} hosts "
+            f"({', '.join(str(h) for h in hosts)}); host-0 rows drive the "
+            "step analysis"
+        )
     lines += [
         "## Verdict",
         "",
@@ -188,7 +234,7 @@ def diagnose(events: list[dict], flight: dict | None = None) -> str:
                 "- per-layer-group diag unavailable for the incident "
                 "(run with `run.diag_every` > 0 to localize the blow-up)"
             )
-        series = _grad_norm_series(events)
+        series = _grad_norm_series(h0)
         before = [(s, g) for s, g in series if s < first_lo][-5:]
         if len(before) >= 2:
             first_g, last_g = before[0][1], before[-1][1]
@@ -246,20 +292,24 @@ def diagnose(events: list[dict], flight: dict | None = None) -> str:
     # -------------------------------------------------------------- timeline
     lines += ["## Timeline", ""]
     t0 = events[0].get("ts", 0) if events else 0
+    # lifecycle rows from host 0 only (merged journals repeat them per host);
+    # flight records and fleet transitions from every host, host-tagged
+    per_run_types = (
+        "run_start",
+        "checkpoint_save",
+        "rollback",
+        "quarantine",
+        "profile",
+        "compiled_program",
+        "shutdown",
+    )
+    fleet_types = ("fleet_straggler", "fleet_host_lost", "fleet_host_rejoined")
     interesting = [
         e
         for e in events
-        if e.get("type")
-        in (
-            "run_start",
-            "checkpoint_save",
-            "rollback",
-            "quarantine",
-            "flight_record",
-            "profile",
-            "compiled_program",
-            "shutdown",
-        )
+        if (e.get("type") in per_run_types and (not multi or _host_of(e) == 0))
+        or e.get("type") in fleet_types
+        or e.get("type") == "flight_record"
     ]
     if not interesting:
         lines.append("(no lifecycle events recorded)")
@@ -280,6 +330,24 @@ def diagnose(events: list[dict], flight: dict | None = None) -> str:
             detail = ", ".join(str(s) for s in e.get("shards", []))
         elif etype == "flight_record":
             detail = f"{e.get('reason')} → {e.get('path')}"
+            if multi:
+                detail = f"[host {_host_of(e)}] {detail}"
+        elif etype == "fleet_straggler":
+            detail = (
+                f"{_fmt_host(e.get('host_id'))} at step {e.get('step')}, "
+                f"lag {e.get('lag')}, symptom {e.get('symptom')}"
+            )
+        elif etype == "fleet_host_lost":
+            detail = (
+                f"{_fmt_host(e.get('host_id'))} "
+                f"(last step {e.get('last_step')}, heartbeat "
+                f"{e.get('heartbeat_age_s')}s stale)"
+            )
+        elif etype == "fleet_host_rejoined":
+            detail = (
+                f"{_fmt_host(e.get('host_id'))} at step {e.get('step')} "
+                f"after {e.get('lost_for_s')}s"
+            )
         elif etype == "shutdown":
             detail = f"{e.get('reason')} at step {e.get('step')}"
         elif etype == "run_start":
@@ -321,7 +389,7 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     try:
-        events = read_journal(args.path)
+        events = read_merged_journal(args.path)
     except FileNotFoundError as e:
         print(f"[run_doctor] {e}", file=sys.stderr)
         return 2
