@@ -260,6 +260,16 @@ def main(argv: list[str] | None = None) -> Path | None:
     # so a replica rebuilt after a promote comes up on the new weights
     ckpt_ref = {"ckpt": args.ckpt}
 
+    # retrace sentinel (obs/retrace.py): once warmup has pre-compiled the
+    # serving executables, the serve loop must be compile-free — armed
+    # after the first served batch, every further XLA compile warns with
+    # shape/dtype-diff attribution and counts into retrace_events_total
+    retrace_sentinel = None
+    if args.serve and args.warmup and cfg.run.retrace:
+        from jumbo_mae_tpu_tpu.obs.retrace import RetraceSentinel
+
+        retrace_sentinel = RetraceSentinel("predict")
+
     def make_engine():
         return InferenceEngine(
             cfg,
@@ -351,13 +361,27 @@ def main(argv: list[str] | None = None) -> Path | None:
         from jumbo_mae_tpu_tpu.infer import ReplicaSet, WeightSwapController
 
         def engine_provider(idx):
+            # a (re)built replica compiles its own executables — during
+            # chaos restarts that happens while the sentinel is armed, and
+            # it is legitimate, not a retrace
+            if retrace_sentinel is not None:
+                with retrace_sentinel.expected("replica build"):
+                    eng = make_engine()
+                    if args.warmup:
+                        eng.warmup((args.task,), pool=args.pool)
+                    return eng
             eng = make_engine()
             if args.warmup:
                 eng.warmup((args.task,), pool=args.pool)
             return eng
 
         def run_replica(eng, batch, metas):
-            return eng.predict(batch, task=args.task, **kw)
+            if retrace_sentinel is None:
+                return eng.predict(batch, task=args.task, **kw)
+            retrace_sentinel.note("replica_batch", batch)
+            out = eng.predict(batch, task=args.task, **kw)
+            retrace_sentinel.arm()  # first batch served: steady state
+            return out
 
         rs = ReplicaSet(
             engine_provider,
@@ -526,7 +550,12 @@ def main(argv: list[str] | None = None) -> Path | None:
         def run_fn(batch):
             if health is not None:
                 health.beat("infer_batch")
-            return engine.predict(batch, task=args.task, **kw)
+            if retrace_sentinel is None:
+                return engine.predict(batch, task=args.task, **kw)
+            retrace_sentinel.note("serve_batch", batch)
+            out = engine.predict(batch, task=args.task, **kw)
+            retrace_sentinel.arm()  # first batch served: steady state
+            return out
 
         with MicroBatcher(
             run_fn,
@@ -601,6 +630,14 @@ def main(argv: list[str] | None = None) -> Path | None:
         result.parent.mkdir(parents=True, exist_ok=True)
         np.savez(result, **payload)
         print(f"[predict] wrote {args.task} for {len(names)} image(s) -> {result}")
+    if retrace_sentinel is not None:
+        rsum = retrace_sentinel.summary()
+        print(
+            f"[predict] retrace sentinel: {rsum['violations']} unexpected "
+            f"recompile(s) after warmup ({rsum['compiles']} compiles seen, "
+            f"{rsum['expected']} expected)"
+        )
+        retrace_sentinel.close()
     if telemetry is not None:
         if args.metrics_hold_s > 0:
             import time

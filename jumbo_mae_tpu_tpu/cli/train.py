@@ -745,6 +745,15 @@ def train(cfg: TrainConfig) -> dict:
         _emit("flight_record", reason=reason, path=str(path))
         print(f"[obs] flight record ({reason}) -> {path}")
 
+    # retrace sentinel (obs/retrace.py): armed after the first step, every
+    # further XLA compile journals a `retrace` event with shape/dtype-diff
+    # attribution — unless it's expected (eval, fault-inject executables)
+    retrace_sentinel = None
+    if run.retrace:
+        from jumbo_mae_tpu_tpu.obs.retrace import RetraceSentinel
+
+        retrace_sentinel = RetraceSentinel("train", journal=journal)
+
     if journal is not None:
         health.probe("journal", lambda: str(journal.path))
     _emit(
@@ -888,14 +897,23 @@ def train(cfg: TrainConfig) -> dict:
                     gm = fault_point("train.grad", key=str(step), data=1.0)
                     if (lm, gm) != (1.0, 1.0):
                         inject = np.asarray([lm, gm], np.float32)
+                if retrace_sentinel is not None:
+                    retrace_sentinel.note("train_step", batch)
                 with sp_step:
                     if inject is None:
                         state, metrics = train_step(state, batch)
+                    elif retrace_sentinel is not None:
+                        # the inject arm is a distinct (legitimate)
+                        # executable — its first compile is not a retrace
+                        with retrace_sentinel.expected("fault-inject"):
+                            state, metrics = train_step(state, batch, inject)
                     else:
                         state, metrics = train_step(state, batch, inject)
                 c_steps.inc()
                 g_step.set(step)
                 health.beat("train_step")
+                if retrace_sentinel is not None and step == start_step + 1:
+                    retrace_sentinel.arm()  # warmup over: steady state begins
                 if diag_on:
                     # keep the (G,3) stats array OUT of the scalar pending list
                     # (the meter/sentinel consume scalars); fetch it only at the
@@ -1138,7 +1156,13 @@ def train(cfg: TrainConfig) -> dict:
                     for k in [k for k in cursor_log if k <= step]:
                         del cursor_log[k]
                     if valid_factory is not None:
-                        val = evaluate(eval_step, state, valid_factory(), pad_batch)
+                        if retrace_sentinel is not None:
+                            with retrace_sentinel.expected("eval"):
+                                val = evaluate(
+                                    eval_step, state, valid_factory(), pad_batch
+                                )
+                        else:
+                            val = evaluate(eval_step, state, valid_factory(), pad_batch)
                         logger.log(val, step=step)
                         last_metrics |= val
                         with sp_ckpt:
@@ -1194,6 +1218,15 @@ def train(cfg: TrainConfig) -> dict:
                 pass
         raise
     finally:
+        if retrace_sentinel is not None:
+            rsum = retrace_sentinel.summary()
+            print(
+                f"[train] retrace sentinel: {rsum['violations']} unexpected "
+                f"recompile(s) after warmup "
+                f"({rsum['compiles']} compiles seen, "
+                f"{rsum['expected']} expected)"
+            )
+            retrace_sentinel.close()
         _emit("shutdown", reason=exit_reason, step=step)
         _beacon_write(step)  # final heartbeat: a clean exit is not a lost host
         if flightrec is not None:

@@ -108,6 +108,10 @@ class RunConfig:
     diag_every: int = 0
     journal: bool = True
     flightrec_steps: int = 256
+    # retrace sentinel (obs/retrace.py): after warmup, any XLA recompile
+    # journals a `retrace` event with shape/dtype-diff attribution and
+    # warns. Costs one jax.monitoring listener + a dict lookup per step.
+    retrace: bool = True
     # telemetry (jumbo_mae_tpu_tpu/obs): metrics are always *recorded*; the
     # exporter serving them over HTTP (/metrics Prometheus text, /healthz)
     # is opt-in. Port 0 binds any free port (the chosen one is printed).

@@ -82,6 +82,21 @@ _EXCEPTIONS = {
 
 _ACTIONS = ("raise", "delay", "corrupt", "nan")
 
+# Every wired ``fault_point(...)`` site. Free-form names still work at
+# runtime, but plans naming a site outside this tuple can never fire —
+# ``tools.graftlint`` CON003 cross-checks plan strings (tests, CI, README
+# cookbook) and call sites against it, so typos surface statically.
+KNOWN_SITES = (
+    "data.shard_open",
+    "data.decode",
+    "train.loss",
+    "train.grad",
+    "serve.submit",
+    "serve.replica",
+    "ckpt.save",
+    "ckpt.load",
+)
+
 
 class FaultInjected(RuntimeError):
     """Default marker mixin-free exception is OSError; this name is only

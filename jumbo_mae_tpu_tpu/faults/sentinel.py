@@ -100,7 +100,7 @@ class DivergenceSentinel:
         )
         self._m_spikes = reg.counter(
             "train_loss_spikes_total",
-            f"steps whose loss exceeded spike_factor x EMA",
+            "steps whose loss exceeded spike_factor x EMA",
         )
         self._m_rollbacks = reg.counter(
             "train_rollbacks_total",

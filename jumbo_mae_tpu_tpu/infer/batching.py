@@ -55,6 +55,7 @@ from typing import Any, Callable
 import numpy as np
 
 from jumbo_mae_tpu_tpu.faults.inject import fault_point
+from jumbo_mae_tpu_tpu.obs import lockwatch
 from jumbo_mae_tpu_tpu.obs.metrics import RATIO_BUCKETS, get_registry
 
 _STOP = object()
@@ -162,7 +163,7 @@ class MicroBatcher:
         self._depth = 0               # submitted, not yet popped by the loop
         self._submitted = 0           # lifetime submit attempts (incl. sheds)
         self._shed_n = 0              # lifetime QueueFullError sheds
-        self._depth_lock = threading.Lock()
+        self._depth_lock = lockwatch.lock("batcher.depth")
         self._closed = False
         self._drain = True
         self._thread = threading.Thread(

@@ -73,6 +73,7 @@ import numpy as np
 from jumbo_mae_tpu_tpu.config import TrainConfig
 from jumbo_mae_tpu_tpu.infer import warmcache as wc
 from jumbo_mae_tpu_tpu.infer.quant import dequantize_tree, quantize_params
+from jumbo_mae_tpu_tpu.obs import lockwatch
 from jumbo_mae_tpu_tpu.obs.metrics import RATIO_BUCKETS, get_registry
 from jumbo_mae_tpu_tpu.models import (
     DecoderConfig,
@@ -349,7 +350,7 @@ class InferenceEngine:
             )
         self._enc_cache_size = int(encoder_cache)
         self._enc_cache: OrderedDict[str, tuple] = OrderedDict()
-        self._enc_cache_lock = threading.Lock()
+        self._enc_cache_lock = lockwatch.lock("engine.enc_cache")
         self.encoder_cache_hits = 0
         self.encoder_cache_misses = 0
 
@@ -377,7 +378,7 @@ class InferenceEngine:
         # per-dispatch drift gauge and bench_infer's ledger row
         self.cost_reports: dict[tuple[str, int], Any] = {}
         self._pred_s: dict[tuple[str, int], float] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("engine.master")
         # one lock per (task, bucket): warmup threads compile distinct
         # executables concurrently (XLA releases the GIL) while two racers
         # for the SAME key still serialize
@@ -720,7 +721,9 @@ class InferenceEngine:
         with self._lock:
             lk = self._key_locks.get(key)
             if lk is None:
-                lk = self._key_locks[key] = threading.Lock()
+                lk = self._key_locks[key] = lockwatch.lock(
+                    f"engine.compile[{key[0]}/{key[1]}]"
+                )
             return lk
 
     def _executable(self, task: str, pool: str | None, bucket: int):

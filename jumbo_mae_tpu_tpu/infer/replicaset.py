@@ -66,6 +66,7 @@ from typing import Any, Callable
 import numpy as np
 
 from jumbo_mae_tpu_tpu.faults.inject import fault_point
+from jumbo_mae_tpu_tpu.obs import lockwatch
 from jumbo_mae_tpu_tpu.infer.batching import (
     DeadlineExceededError,
     QueueFullError,
@@ -105,7 +106,7 @@ class _Request:
         self.retries = 0
         self.t0 = time.perf_counter()
         self._settled = False
-        self._lock = threading.Lock()
+        self._lock = lockwatch.lock("replicaset.request")
 
     def settle(self) -> bool:
         """Claim the exclusive right to resolve this request. Exactly one
@@ -283,14 +284,14 @@ class ReplicaSet:
         self._depth = 0
         self._submitted = 0
         self._shed_n = 0
-        self._depth_lock = threading.Lock()
+        self._depth_lock = lockwatch.lock("replicaset.depth")
         self._live: set[_Request] = set()
-        self._live_lock = threading.Lock()
+        self._live_lock = lockwatch.lock("replicaset.live")
         self._closed = False
         self._drain = True
         self._breaker_open = False
         self._canary_pref: str | None = None
-        self._state_lock = threading.Lock()
+        self._state_lock = lockwatch.lock("replicaset.state")
 
         self._slots: list[_Replica] = []
         self._fails = [0] * self.n
@@ -896,7 +897,7 @@ class WeightSwapController:
         self.drain_timeout_s = float(drain_timeout_s)
         self._on_promote = on_promote
         self._clock = clock
-        self._swap_lock = threading.Lock()
+        self._swap_lock = lockwatch.lock("replicaset.swap")
         self.last_report: dict | None = None
         reg = registry if registry is not None else get_registry()
         self._m_attempts = reg.counter(

@@ -27,6 +27,12 @@
   the host-0 aggregator (straggler/lost detection, ``fleet_*`` gauges).
 - ``obs.reqtrace`` — per-request trace context for the serving path + the
   crash-safe JSONL access log (``tools/serve_doctor.py`` reads it offline).
+- ``obs.lockwatch`` — opt-in instrumented locks (``GRAFT_LOCKWATCH=1``):
+  runtime lock-order inversion + long-hold detection, ``lock_*`` metrics,
+  ``lock_order_violation`` journal events.
+- ``obs.retrace``  — retrace sentinel: hooks JAX compile telemetry and
+  turns any post-warmup recompile into a ``retrace`` journal event with
+  shape/dtype-diff attribution.
 - ``obs.slo``      — declarative SLO objectives, rolling-window burn rates,
   and the latched degraded flag surfaced in ``/healthz``.
 - ``obs.doctor_common`` — markdown/window helpers shared by the offline
@@ -40,12 +46,15 @@ from jumbo_mae_tpu_tpu.obs.exporter import HealthState, TelemetryServer
 from jumbo_mae_tpu_tpu.obs.fleet import FleetAggregator, HostBeacon, read_beacons
 from jumbo_mae_tpu_tpu.obs.flightrec import FlightRecorder
 from jumbo_mae_tpu_tpu.obs.journal import (
+    JOURNAL_EVENTS,
     RunJournal,
     env_fingerprint,
     journal_dir,
     read_journal,
     read_merged_journal,
 )
+from jumbo_mae_tpu_tpu.obs.lockwatch import WatchedLock
+from jumbo_mae_tpu_tpu.obs.retrace import RetraceSentinel
 from jumbo_mae_tpu_tpu.obs.modelstats import (
     STAT_NAMES,
     first_nonfinite_group,
@@ -151,7 +160,10 @@ __all__ = [
     "RATIO_BUCKETS",
     "RequestTrace",
     "RequestTracer",
+    "JOURNAL_EVENTS",
+    "RetraceSentinel",
     "RunJournal",
+    "WatchedLock",
     "SLOObjective",
     "SLOTracker",
     "STAT_NAMES",

@@ -52,6 +52,32 @@ _SEGMENT_PREFIX = "journal-"
 _SEGMENT_SUFFIX = ".jsonl"
 _HOST_DIR_RE = re.compile(r"^journal-host(\d+)$")
 
+# The frozen event schema: every event type the project emits with a
+# literal name. Free-form types still *work* (the writer doesn't validate
+# at runtime — a crash-safe log must never refuse a row), but readers,
+# doctors, and ``tools.graftlint`` CON002 treat this set as the contract:
+# emitting a literal type outside it is drift, caught statically.
+JOURNAL_EVENTS = frozenset(
+    {
+        "run_start",
+        "step",
+        "checkpoint_save",
+        "sentinel_bad_step",
+        "sentinel_loss_spike",
+        "rollback",
+        "quarantine",
+        "flight_record",
+        "compiled_program",
+        "profile",
+        "shutdown",
+        "fleet_straggler",
+        "fleet_host_lost",
+        "fleet_host_rejoined",
+        "retrace",
+        "lock_order_violation",
+    }
+)
+
 
 def _json_default(obj):
     """Journal payloads carry numpy scalars/arrays and Paths; make them JSON."""

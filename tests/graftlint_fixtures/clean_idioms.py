@@ -1,0 +1,67 @@
+# graftlint fixture: repo idioms that must produce ZERO findings.
+# NEVER imported — parsed only.
+import re
+import threading
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from jumbo_mae_tpu_tpu.faults.inject import fault_point
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def static_branch(x, mode="fast"):
+    # branching on a static arg is fine under tracing
+    if mode == "fast":
+        return x
+    return -x
+
+
+@jax.jit
+def none_gate(x, extra=None):
+    # is-None structure checks resolve at trace time
+    if extra is not None:
+        x = x + extra
+    return x
+
+
+def make_step(use_extra):
+    # closure config flag: resolved at trace time, not a traced value
+    @jax.jit
+    def step(x):
+        if use_extra:
+            return x * 2
+        return jnp.abs(x)
+
+    return step
+
+
+def host_side(x, flag):
+    # not jitted: host control flow and host syncs are fine here
+    if flag:
+        return float(x.mean())
+    return x.item() if hasattr(x, "item") else x
+
+
+_LOCK = threading.Lock()
+
+
+def quick_critical_section(parts):
+    # cheap str/regex work under a lock is not blocking
+    with _LOCK:
+        joined = ",".join(parts)
+        pat = re.compile("a+")
+    time.sleep(0)  # blocking OUTSIDE the lock is fine
+    return joined, pat
+
+
+def known_contracts(cfg, journal):
+    reg = get_registry()
+    reg.counter("infer_requests_total", "documented in the README glossary")
+    journal.event("step", step=1)
+    fault_point("train.loss")
+    argv = ["--set", "run.training_steps=10"]
+    return cfg.run.training_steps, argv

@@ -1,0 +1,15 @@
+# graftlint fixture: seeded CON true positives. NEVER imported — parsed only.
+import os
+
+from jumbo_mae_tpu_tpu.faults.inject import fault_point
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+
+def drifted(cfg, journal):
+    reg = get_registry()
+    reg.counter("orphan_widget_total", "not in the README glossary")  # CON001
+    journal.event("bogus_event", step=1)  # CON002: not in JOURNAL_EVENTS
+    fault_point("serve.bogus")  # CON003: not a registered fault site
+    os.environ["GRAFT_FAULTS"] = "data.shard_opne:raise"  # CON003: typo'd site
+    argv = ["--set", "run.not_a_field=1"]  # CON004: unknown run.* key
+    return cfg.run.bogus_field, argv  # CON004: unknown RunConfig attribute

@@ -1,0 +1,17 @@
+# graftlint fixture: seeded LCK003 lock-order cycle. NEVER imported — parsed only.
+import threading
+
+_ALPHA = threading.Lock()
+_BETA = threading.Lock()
+
+
+def alpha_then_beta():
+    with _ALPHA:
+        with _BETA:  # edge ALPHA -> BETA
+            return 1
+
+
+def beta_then_alpha():
+    with _BETA:
+        with _ALPHA:  # edge BETA -> ALPHA: LCK003 cycle
+            return 2

@@ -1,0 +1,34 @@
+# graftlint fixture: seeded LCK true positives. NEVER imported — parsed only.
+# Engine.warmup reproduces the round-10 warmup deadlock shape: compile work
+# held under the master lock while a callee re-acquires the same lock.
+import threading
+import time
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._execs = {}
+
+    def _task(self):
+        with self._lock:
+            return dict(self._execs)
+
+    def warmup(self, fn, x):
+        with self._lock:
+            self._execs["warm"] = fn.lower()
+            self._task()  # LCK002: callee re-acquires self._lock (round-10 shape)
+
+    def slow_refresh(self):
+        with self._lock:
+            time.sleep(0.5)  # LCK001: blocking sleep while holding the lock
+
+    def reenter(self):
+        with self._lock:
+            with self._lock:  # LCK002: direct re-acquire of a non-reentrant lock
+                pass
+
+    def locked_iter(self):
+        with self._lock:
+            for k in self._execs:
+                yield k  # LCK004: generator yields while holding the lock

@@ -1,0 +1,63 @@
+# graftlint fixture: seeded TRC true positives. NEVER imported — parsed only.
+# Each marked line must be reported by tools.graftlint (see test_graftlint.py).
+import random
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def branchy(x):
+    if x.sum() > 0:  # TRC001: python `if` on a traced value
+        return x
+    return -x
+
+
+@jax.jit
+def spinny(x):
+    while x.min() < 0:  # TRC001: python `while` on a traced value
+        x = x + 1
+    return x
+
+
+@jax.jit
+def asserty(x):
+    assert x.min() >= 0  # TRC001: `assert` on a traced value
+    return x
+
+
+@jax.jit
+def hosty(x):
+    s = float(x.mean())  # TRC002: float() forces a host sync
+    return x * s
+
+
+@jax.jit
+def itemy(x):
+    return x.sum().item()  # TRC002: .item() forces a host sync
+
+
+@jax.jit
+def asarr(x):
+    y = np.asarray(x)  # TRC002: np.asarray materializes the tracer
+    return jnp.asarray(y)
+
+
+@jax.jit
+def clocky(x):
+    t = time.time()  # TRC003: wall clock baked in at trace time
+    return x + t
+
+
+@jax.jit
+def randy(x):
+    return x * random.random()  # TRC003: python RNG baked in at trace time
+
+
+@partial(jax.jit)  # TRC004: str-default arg below, no static_argnames
+def config_shaped(x, mode="fast"):
+    del mode
+    return x

@@ -1,0 +1,326 @@
+"""graftlint: fixture-corpus true positives, clean negatives, baseline
+mechanics, CLI exit codes — plus the two runtime sentinels (lockwatch
+order-inversion detection and the retrace shape-diff attribution path).
+
+The fixture files under ``tests/graftlint_fixtures/`` are parsed, never
+imported: each ``# TRCnnn`` / ``# LCKnnn`` / ``# CONnnn`` comment marks a
+seeded violation the linter must report at that file:line, and
+``clean_idioms.py`` holds repo idioms that must produce zero findings
+(the false-positive budget is exactly 0).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import warnings
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import run_lint
+from tools.graftlint.findings import (
+    EXIT_CLEAN,
+    EXIT_FINDINGS,
+    Baseline,
+    split_by_baseline,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "graftlint_fixtures"
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return run_lint(REPO, [FIXTURES]).findings
+
+
+def _per_file(findings):
+    by = {}
+    for f in findings:
+        by.setdefault(Path(f.path).name, []).append(f)
+    return by
+
+
+# ---------------------------------------------------------------- corpus
+
+
+class TestFixtureCorpus:
+    def test_every_seeded_violation_fires(self, fixture_findings):
+        """Each fixture file yields exactly its seeded rule multiset —
+        an extra finding is a false positive, a missing one a false
+        negative; both fail."""
+        expected = {
+            "trc_hazards.py": Counter(
+                {"TRC001": 3, "TRC002": 3, "TRC003": 2, "TRC004": 1}
+            ),
+            "lck_discipline.py": Counter(
+                {"LCK001": 1, "LCK002": 2, "LCK004": 1}
+            ),
+            "lck_cycle.py": Counter({"LCK003": 1}),
+            "con_drift.py": Counter(
+                {"CON001": 1, "CON002": 1, "CON003": 2, "CON004": 2}
+            ),
+        }
+        by_file = {
+            name: Counter(f.rule for f in fs)
+            for name, fs in _per_file(fixture_findings).items()
+        }
+        assert by_file == expected
+
+    def test_findings_carry_file_and_line(self, fixture_findings):
+        marked = {}
+        for name in ("trc_hazards.py", "lck_discipline.py", "con_drift.py"):
+            for lineno, text in enumerate(
+                (FIXTURES / name).read_text().splitlines(), start=1
+            ):
+                if "# TRC" in text or "# LCK" in text or "# CON" in text:
+                    rule = text.split("# ")[-1].split(":")[0].split()[0]
+                    marked[(name, rule, lineno)] = text
+        for key in marked:
+            name, rule, lineno = key
+            hits = [
+                f
+                for f in fixture_findings
+                if Path(f.path).name == name
+                and f.rule == rule
+                and f.line == lineno
+            ]
+            assert hits, f"no {rule} reported at {name}:{lineno}"
+            assert hits[0].location().endswith(f"{name}:{lineno}")
+
+    def test_round10_shape_is_named(self, fixture_findings):
+        """The warmup-deadlock class that bit round 10 must be called out
+        as such: callee re-acquiring a lock the frame already holds."""
+        (f,) = [
+            f
+            for f in fixture_findings
+            if f.rule == "LCK002" and f.scope == "Engine.warmup"
+        ]
+        assert "round-10" in f.message
+        assert "_task" in f.message
+
+    def test_cycle_names_both_locks(self, fixture_findings):
+        (f,) = [f for f in fixture_findings if f.rule == "LCK003"]
+        assert "_ALPHA" in f.message and "_BETA" in f.message
+
+    def test_clean_idioms_zero_findings(self):
+        res = run_lint(REPO, [FIXTURES / "clean_idioms.py"])
+        assert res.findings == []
+        assert res.files_scanned == 1
+
+
+# --------------------------------------------------------------- baseline
+
+
+class TestBaseline:
+    def test_reason_is_mandatory(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(
+            json.dumps({"findings": {"LCK001|a.py|f|0123456789ab": {}}})
+        )
+        with pytest.raises(ValueError, match="reason"):
+            Baseline.load(p)
+
+    def test_split_accepts_and_reports_stale(self, fixture_findings, tmp_path):
+        lck = [f for f in fixture_findings if f.rule.startswith("LCK")]
+        entries = {
+            f.key: {"reason": "fixture: deliberately seeded"} for f in lck
+        }
+        entries["LCK001|gone.py|f|000000000000"] = {
+            "reason": "stale: file was deleted"
+        }
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps({"findings": entries}))
+        bl = Baseline.load(p)
+        fresh, accepted = split_by_baseline(fixture_findings, bl)
+        assert not any(f.rule.startswith("LCK") for f in fresh)
+        assert {f.key for f in accepted} == {f.key for f in lck}
+        assert bl.stale_keys(fixture_findings) == [
+            "LCK001|gone.py|f|000000000000"
+        ]
+
+    def test_shipped_baseline_is_exact(self):
+        """The checked-in baseline covers the tree with no fresh findings
+        and no stale entries — the CI gate's exact precondition."""
+        res = run_lint(REPO)
+        bl = Baseline.load(REPO / ".graftlint-baseline.json")
+        fresh, accepted = split_by_baseline(res.findings, bl)
+        assert fresh == [], [f.location() for f in fresh]
+        assert bl.stale_keys(res.findings) == []
+        assert len(accepted) == 6
+
+
+# -------------------------------------------------------------------- CLI
+
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", *args],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    def test_fixture_corpus_exits_2(self):
+        proc = self._run("tests/graftlint_fixtures", "--no-baseline")
+        assert proc.returncode == EXIT_FINDINGS, proc.stdout + proc.stderr
+        for rule in ("TRC001", "TRC004", "LCK002", "LCK003", "CON003"):
+            assert rule in proc.stdout
+
+    def test_shipped_tree_exits_0(self):
+        proc = self._run()
+        assert proc.returncode == EXIT_CLEAN, proc.stdout + proc.stderr
+        assert "CLEAN" in proc.stdout
+
+    def test_report_file(self, tmp_path):
+        out = tmp_path / "report.md"
+        proc = self._run(
+            "tests/graftlint_fixtures/clean_idioms.py",
+            "--no-baseline",
+            "--report",
+            str(out),
+        )
+        assert proc.returncode == EXIT_CLEAN
+        assert "CLEAN" in out.read_text()
+
+
+# -------------------------------------------------- runtime: lockwatch
+
+
+class TestLockwatchRuntime:
+    def test_order_inversion_detected_and_journaled(self):
+        from jumbo_mae_tpu_tpu.obs import lockwatch
+
+        events = []
+
+        class _Journal:
+            def event(self, etype, **payload):
+                events.append((etype, payload))
+                return payload
+
+        lockwatch.reset()
+        lockwatch.enable()
+        lockwatch.attach_journal(_Journal())
+        try:
+            a = lockwatch.lock("fixture.A")
+            b = lockwatch.lock("fixture.B")
+
+            def a_then_b():
+                with a:
+                    with b:
+                        pass
+
+            def b_then_a():
+                with b:
+                    with a:
+                        pass
+
+            # Sequential threads: establishes edge A->B, then observes
+            # B->A — an inversion, with zero actual deadlock risk.
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                for fn in (a_then_b, b_then_a):
+                    t = threading.Thread(target=fn)
+                    t.start()
+                    t.join()
+
+            vs = lockwatch.violations()
+            assert len(vs) == 1
+            assert vs[0]["held"] == "fixture.B"
+            assert vs[0]["acquired"] == "fixture.A"
+            journaled = [p for e, p in events if e == "lock_order_violation"]
+            assert journaled and journaled[0]["held"] == "fixture.B"
+        finally:
+            lockwatch.attach_journal(None)
+            lockwatch.disable()
+            lockwatch.reset()
+
+    def test_disabled_returns_plain_lock(self):
+        from jumbo_mae_tpu_tpu.obs import lockwatch
+
+        lockwatch.reset()
+        lockwatch.disable()
+        lk = lockwatch.lock("fixture.plain")
+        assert not isinstance(lk, lockwatch.WatchedLock)
+        with lk:
+            pass
+        assert lockwatch.violations() == []
+
+
+# ---------------------------------------------------- runtime: retrace
+
+
+class TestRetraceRuntime:
+    def test_shape_change_is_attributed_and_journaled(self):
+        import jax
+        import jax.numpy as jnp
+
+        from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+        from jumbo_mae_tpu_tpu.obs.retrace import RetraceSentinel
+
+        events = []
+
+        class _Journal:
+            def event(self, etype, **payload):
+                events.append((etype, payload))
+                return payload
+
+        sentinel = RetraceSentinel(
+            "fixture", journal=_Journal(), registry=MetricsRegistry()
+        )
+        try:
+            fn = jax.jit(lambda t: t * 2 + 1)
+            x = jnp.ones((2, 3))
+            y = jnp.ones((4, 3))  # built pre-arm: its compile is warmup
+            sentinel.note("step", x)
+            fn(x).block_until_ready()  # warmup compile, unarmed
+            sentinel.arm()
+
+            sentinel.note("step", y)  # records the (2,3)->(4,3) change
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                fn(y).block_until_ready()  # recompile while armed
+
+            assert sentinel.summary()["violations"] >= 1
+            rows = [p for e, p in events if e == "retrace"]
+            assert rows, "no retrace event journaled"
+            row = rows[0]
+            assert row["tag"] == "step"
+            diff = row["diff"]
+            assert diff and diff[0]["prev_shape"] == [2, 3]
+            assert diff[0]["new_shape"] == [4, 3]
+            with pytest.raises(AssertionError):
+                sentinel.assert_steady()
+        finally:
+            sentinel.close()
+
+    def test_expected_block_suppresses_violation(self):
+        import jax
+        import jax.numpy as jnp
+
+        from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+        from jumbo_mae_tpu_tpu.obs.retrace import RetraceSentinel
+
+        sentinel = RetraceSentinel("fixture2", registry=MetricsRegistry())
+        try:
+            fn = jax.jit(lambda t: t - 1)
+            x = jnp.ones((3,))
+            y = jnp.ones((5,))
+            sentinel.note("step", x)
+            fn(x).block_until_ready()
+            sentinel.arm()
+            sentinel.note("step", y)
+            with sentinel.expected("fixture growth"):
+                fn(y).block_until_ready()
+            summary = sentinel.summary()
+            assert summary["violations"] == 0
+            assert summary["expected"] >= 1
+            sentinel.assert_steady()  # must NOT raise
+        finally:
+            sentinel.close()

@@ -1,0 +1,125 @@
+"""CLI: ``python -m tools.graftlint [paths...]``.
+
+Exit codes: 0 clean, 2 unbaselined findings. The markdown report goes to
+stdout (and to ``--report PATH`` for CI artifact upload).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.graftlint import DEFAULT_PATHS, run_lint
+from tools.graftlint.findings import (
+    EXIT_CLEAN,
+    EXIT_FINDINGS,
+    Baseline,
+    render_report,
+    split_by_baseline,
+)
+
+BASELINE_NAME = ".graftlint-baseline.json"
+
+
+def _find_root(start: Path) -> Path:
+    cur = start.resolve()
+    while True:
+        if (cur / "jumbo_mae_tpu_tpu").is_dir() or (cur / ".git").exists():
+            return cur
+        if cur.parent == cur:
+            return start.resolve()
+        cur = cur.parent
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description=(
+            "Project-native static analysis: JAX tracing hazards (TRC), "
+            "lock discipline (LCK), contract drift (CON)."
+        ),
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)} "
+        "under the repo root, plus repo-wide contract checks)",
+    )
+    ap.add_argument("--root", help="repo root (default: walk up from cwd)")
+    ap.add_argument(
+        "--baseline",
+        help=f"baseline file (default: <root>/{BASELINE_NAME})",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: report every finding",
+    )
+    ap.add_argument("--report", help="also write the markdown report here")
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept all current unbaselined findings into the baseline "
+        "(requires --reason; refine per-entry reasons by editing the file)",
+    )
+    ap.add_argument(
+        "--reason",
+        help="reason string recorded for entries added by --write-baseline",
+    )
+    args = ap.parse_args(argv)
+
+    root = Path(args.root).resolve() if args.root else _find_root(Path.cwd())
+    paths = [Path(p).resolve() for p in args.paths] or None
+    baseline_path = (
+        Path(args.baseline) if args.baseline else root / BASELINE_NAME
+    )
+    try:
+        baseline = (
+            Baseline() if args.no_baseline else Baseline.load(baseline_path)
+        )
+    except ValueError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return EXIT_FINDINGS
+
+    result = run_lint(root, paths)
+    fresh, accepted = split_by_baseline(result.findings, baseline)
+    stale = baseline.stale_keys(result.findings)
+
+    if args.write_baseline:
+        if not args.reason:
+            print(
+                "graftlint: --write-baseline requires --reason", file=sys.stderr
+            )
+            return EXIT_FINDINGS
+        merged = dict(baseline.entries)
+        import json
+
+        new = json.loads(Baseline.render(fresh, args.reason))["findings"]
+        merged.update(new)
+        doc = Baseline.render([], "")  # shape only; replace entries
+        payload = json.loads(doc)
+        payload["findings"] = dict(sorted(merged.items()))
+        baseline_path.write_text(
+            json.dumps(payload, indent=2, sort_keys=True) + "\n"
+        )
+        print(
+            f"graftlint: wrote {len(new)} entr"
+            f"{'y' if len(new) == 1 else 'ies'} to {baseline_path}"
+        )
+        return EXIT_CLEAN
+
+    report = render_report(
+        fresh, accepted, stale, files_scanned=result.files_scanned
+    )
+    if args.report:
+        Path(args.report).write_text(report)
+    try:
+        print(report)
+    except BrokenPipeError:  # `| head` closed stdout; the verdict stands
+        sys.stderr.close()
+    return EXIT_CLEAN if not fresh else EXIT_FINDINGS
+
+
+if __name__ == "__main__":
+    sys.exit(main())

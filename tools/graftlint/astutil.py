@@ -1,0 +1,187 @@
+"""Shared AST plumbing for the graftlint checkers.
+
+Everything here is deliberately *syntactic*: no imports of the scanned
+code, no type inference. The checkers buy zero false positives by only
+claiming what the AST states outright (a decorator literally named
+``jax.jit``, a ``with self._lock:`` block, a string literal argument) and
+leaving anything that would need dataflow analysis alone.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class SourceFile:
+    path: Path           # absolute
+    rel: str             # repo-relative, posix
+    tree: ast.Module
+    lines: list[str]
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def parse_file(path: Path, root: Path) -> SourceFile | None:
+    try:
+        text = path.read_text()
+        tree = ast.parse(text, filename=str(path))
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+    annotate_parents(tree)
+    rel = path.relative_to(root).as_posix() if root in path.parents or path == root else str(path)
+    return SourceFile(path=path, rel=rel, tree=tree, lines=text.splitlines())
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.graftlint_parent = node  # type: ignore[attr-defined]
+
+
+def parents(node: ast.AST):
+    cur = getattr(node, "graftlint_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "graftlint_parent", None)
+
+
+def enclosing_scope(node: ast.AST) -> str:
+    """``Class.method`` / ``function`` / ``<module>`` for a node."""
+    names: list[str] = []
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.append(p.name)
+    return ".".join(reversed(names)) or "<module>"
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+@dataclass
+class JitInfo:
+    """One jitted function found in a module: the def plus jit-call facts."""
+
+    func: ast.FunctionDef
+    static_names: set[str] = field(default_factory=set)
+    jit_call: ast.Call | None = None
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _static_names_of(call: ast.Call) -> set[str]:
+    """Parameter names pinned static by a jit call's kwargs."""
+    out: set[str] = set()
+    kw = keyword_arg(call, "static_argnames")
+    if kw is not None:
+        if (s := str_const(kw)) is not None:
+            out.add(s)
+        elif isinstance(kw, (ast.Tuple, ast.List)):
+            out |= {s for e in kw.elts if (s := str_const(e)) is not None}
+    return out
+
+
+def _jit_call_of_decorator(dec: ast.expr) -> ast.Call | None:
+    """The jit Call carrying kwargs, for any of the decorator spellings:
+    ``@jax.jit``, ``@jax.jit`` called, ``@partial(jax.jit, ...)``."""
+    if dotted_name(dec) in _JIT_NAMES:
+        return None  # bare decorator: jitted, but no kwargs to read
+    if isinstance(dec, ast.Call):
+        name = call_name(dec)
+        if name in _JIT_NAMES:
+            return dec
+        if name in ("partial", "functools.partial") and dec.args:
+            if dotted_name(dec.args[0]) in _JIT_NAMES:
+                return dec
+    return None
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    if dotted_name(dec) in _JIT_NAMES:
+        return True
+    return _jit_call_of_decorator(dec) is not None
+
+
+def find_jitted_functions(sf: SourceFile) -> list[JitInfo]:
+    """Functions jitted in this module: by decorator, or by being passed
+    (module-locally) as the first argument of a ``jax.jit(...)`` call."""
+    by_name: dict[str, ast.FunctionDef] = {}
+    jitted: dict[int, JitInfo] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                if _is_jit_decorator(dec):
+                    info = jitted.setdefault(id(node), JitInfo(func=node))
+                    call = _jit_call_of_decorator(dec)
+                    if call is not None:
+                        info.jit_call = call
+                        info.static_names |= _static_names_of(call)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and call_name(node) in _JIT_NAMES:
+            if node.args and (target := dotted_name(node.args[0])):
+                fn = by_name.get(target)
+                if fn is not None:
+                    info = jitted.setdefault(id(fn), JitInfo(func=fn))
+                    info.jit_call = node
+                    info.static_names |= _static_names_of(node)
+    return list(jitted.values())
+
+
+def param_names(func: ast.FunctionDef) -> list[str]:
+    args = func.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def iter_py_files(paths: list[Path]) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        if p.is_dir():
+            out.extend(
+                f for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py":
+            out.append(p)
+    return out

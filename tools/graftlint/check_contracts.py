@@ -1,0 +1,508 @@
+"""CON — project contract drift between code, registries, and docs.
+
+| Rule   | Claim |
+|--------|-------|
+| CON001 | A metric registered in code has no row in the README glossary
+|        | (or a glossary row names a metric nothing registers). |
+| CON002 | A journal event emitted with a literal name is not in the
+|        | frozen ``obs.journal.JOURNAL_EVENTS`` schema list (or a README
+|        | journal-table row names an event the schema doesn't). |
+| CON003 | A ``fault_point(...)`` site, or a site named in a GRAFT_FAULTS
+|        | plan string (code, tests, CI, README cookbook), is not in
+|        | ``faults.inject.KNOWN_SITES``. |
+| CON004 | A ``--set section.key=...`` reference or a ``cfg.<section>.<key>``
+|        | attribute access names a config key the dataclasses don't have. |
+
+The registries are read by *parsing* the defining modules (AST, no
+imports), so the checker works on any tree that merely contains them.
+Dynamic registrations (f-string metric names) are tracked as prefixes so
+documented families like ``xla_*`` don't read as stale.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.graftlint.astutil import (
+    SourceFile,
+    call_name,
+    dotted_name,
+    enclosing_scope,
+    parents,
+    str_const,
+)
+from tools.graftlint.findings import Finding
+
+CHECKER = "contract drift"
+
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+_SECTION_FILES = {
+    "run": ("jumbo_mae_tpu_tpu/config.py", "RunConfig"),
+    "model": ("jumbo_mae_tpu_tpu/config.py", "ModelConfig"),
+    "optim": ("jumbo_mae_tpu_tpu/train/optim.py", "OptimConfig"),
+    "data": ("jumbo_mae_tpu_tpu/data/loader.py", "DataConfig"),
+    "mesh": ("jumbo_mae_tpu_tpu/parallel/mesh.py", "MeshConfig"),
+}
+_CONFIG_REF_RE = re.compile(
+    r"\b(run|model|optim|data|mesh)\.([a-z_][a-z0-9_]*)\s*="
+)
+_PLAN_SITE_RE = re.compile(r"^\s*([a-z]+\.[a-z_][a-z0-9_]*)\s*:")
+_GRAFT_FAULTS_RE = re.compile(r"""GRAFT_FAULTS[=:]\s*["']?([^"'\s][^"'\n]*)""")
+_NAME_TOKEN_RE = re.compile(r"`([a-z_][a-z0-9_]*)(?:\{[^}`]*\})?`")
+
+
+def _dataclass_fields(tree: ast.Module, class_name: str) -> set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {
+                item.target.id
+                for item in node.body
+                if isinstance(item, ast.AnnAssign)
+                and isinstance(item.target, ast.Name)
+            }
+    return set()
+
+
+def _string_set(tree: ast.Module, var_name: str) -> set[str]:
+    """Literal elements of ``VAR = frozenset({...})`` / tuple / set / list."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if var_name not in targets:
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and value.args:
+                value = value.args[0]
+            if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+                return {
+                    s for e in value.elts if (s := str_const(e)) is not None
+                }
+    return set()
+
+
+@dataclass
+class Registries:
+    """The project's frozen contracts, parsed from their defining files."""
+
+    known_sites: set[str] = field(default_factory=set)
+    journal_events: set[str] = field(default_factory=set)
+    config_fields: dict[str, set[str]] = field(default_factory=dict)
+    readme_metrics: set[str] = field(default_factory=set)
+    readme_dynamic: bool = False
+    readme_journal_rows: list[tuple[str, int]] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, root: Path) -> "Registries":
+        regs = cls()
+        inject = root / "jumbo_mae_tpu_tpu/faults/inject.py"
+        if inject.exists():
+            regs.known_sites = _string_set(
+                ast.parse(inject.read_text()), "KNOWN_SITES"
+            )
+        journal = root / "jumbo_mae_tpu_tpu/obs/journal.py"
+        if journal.exists():
+            regs.journal_events = _string_set(
+                ast.parse(journal.read_text()), "JOURNAL_EVENTS"
+            )
+        for section, (rel, class_name) in _SECTION_FILES.items():
+            path = root / rel
+            if path.exists():
+                regs.config_fields[section] = _dataclass_fields(
+                    ast.parse(path.read_text()), class_name
+                )
+        readme = root / "README.md"
+        if readme.exists():
+            regs._parse_readme(readme.read_text())
+        return regs
+
+    def _parse_readme(self, text: str) -> None:
+        in_journal_table = False
+        for lineno, line in enumerate(text.splitlines(), 1):
+            cells = [c.strip() for c in line.split("|")]
+            is_row = line.lstrip().startswith("|") and len(cells) >= 3
+            if not is_row:
+                in_journal_table = False
+                continue
+            first, second = cells[1], cells[2]
+            if first == "`type`" and second.lower() == "when":
+                in_journal_table = True
+                continue
+            if in_journal_table and not set(first) <= {"-", " "}:
+                for name in _NAME_TOKEN_RE.findall(first):
+                    self.readme_journal_rows.append((name, lineno))
+                continue
+            if re.search(r"\b(gauge|counter|histogram)\b", second):
+                if "…" in first or "..." in first:
+                    continue  # explicitly-dynamic row: prefix family
+                self.readme_metrics |= set(_NAME_TOKEN_RE.findall(first))
+
+
+def _finding(sf: SourceFile, rule: str, node: ast.AST, msg: str) -> Finding:
+    return Finding(
+        rule=rule,
+        path=sf.rel,
+        line=node.lineno,
+        scope=enclosing_scope(node),
+        message=msg,
+        snippet=sf.snippet(node.lineno),
+        checker=CHECKER,
+    )
+
+
+def _is_docstring(node: ast.Constant) -> bool:
+    parent = getattr(node, "graftlint_parent", None)
+    return isinstance(parent, ast.Expr)
+
+
+def _fstring_prefix(node: ast.AST) -> str | None:
+    """The literal prefix of an f-string like ``f"xla_{k}"``."""
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+    return None
+
+
+def _module_literal_table(sf: SourceFile, expr: ast.expr):
+    """Resolve ``expr`` to a literal tuple/list, following one module-level
+    Name assignment (``_GAUGES = (...)`` then ``for ... in _GAUGES``)."""
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return expr
+    if isinstance(expr, ast.Name):
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == expr.id
+                for t in node.targets
+            ):
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    return node.value
+    return None
+
+
+def _loop_table_names(name_arg: ast.Name, sf: SourceFile) -> set[str]:
+    """Names a loop-variable registration can take, for the common
+    table-driven idiom: ``for field, name, help in _TABLE: reg.gauge(name,
+    ...)`` (statement loop or comprehension, table a module-level literal).
+    Returns the string elements at the variable's tuple position."""
+    for p in parents(name_arg):
+        if isinstance(p, ast.For):
+            loops = [(p.target, p.iter)]
+        elif isinstance(p, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            loops = [(g.target, g.iter) for g in p.generators]
+        else:
+            continue
+        for target, iter_expr in loops:
+            idx: int | None = None
+            if isinstance(target, ast.Name) and target.id == name_arg.id:
+                idx = -1  # scalar loop var: every string in the table
+            elif isinstance(target, ast.Tuple):
+                for i, elt in enumerate(target.elts):
+                    if isinstance(elt, ast.Name) and elt.id == name_arg.id:
+                        idx = i
+            if idx is None:
+                continue
+            table = _module_literal_table(sf, iter_expr)
+            if table is None:
+                continue
+            out: set[str] = set()
+            for row in table.elts:
+                if idx == -1:
+                    if (s := str_const(row)) is not None:
+                        out.add(s)
+                elif isinstance(row, (ast.Tuple, ast.List)) and idx < len(row.elts):
+                    if (s := str_const(row.elts[idx])) is not None:
+                        out.add(s)
+            if out:
+                return out
+    return set()
+
+
+def _plan_literals(sf: SourceFile):
+    """(string, node) pairs that carry a fault-injection plan: arguments of
+    ``install_plan``/``FaultPlan.parse``, ``faults=`` keywords, and values
+    bound to a GRAFT_FAULTS env key (assignment or dict literal)."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name and name.split(".")[-1] == "install_plan":
+                if node.args and (s := str_const(node.args[0])) is not None:
+                    yield s, node
+            for kw in node.keywords:
+                if kw.arg == "faults" and (s := str_const(kw.value)) is not None:
+                    yield s, kw.value
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and (key := str_const(tgt.slice)) == "GRAFT_FAULTS"
+                    and (s := str_const(node.value)) is not None
+                ):
+                    yield s, node
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (
+                    k is not None
+                    and str_const(k) == "GRAFT_FAULTS"
+                    and (s := str_const(v)) is not None
+                ):
+                    yield s, v
+
+
+def _plan_sites(plan: str) -> list[str]:
+    sites = []
+    for part in plan.split(";"):
+        m = _PLAN_SITE_RE.match(part)
+        if m:
+            sites.append(m.group(1))
+    return sites
+
+
+@dataclass
+class ContractScan:
+    findings: list[Finding] = field(default_factory=list)
+    # literal metric registrations: name -> (rel, line) of first sight
+    registered: dict[str, tuple[str, int]] = field(default_factory=dict)
+    # f-string registrations: literal name prefixes ("xla_", "slo_")
+    dynamic_prefixes: set[str] = field(default_factory=set)
+
+
+def check_contracts_py(
+    sf: SourceFile, regs: Registries, scan: ContractScan
+) -> None:
+    """File-anchored contract checks + metric-registration collection."""
+    skip_metrics = sf.rel.endswith("obs/metrics.py")
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            # --- metric registrations ------------------------------------
+            if (
+                not skip_metrics
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_METHODS
+                and len(node.args) >= 2
+            ):
+                name_arg = node.args[0]
+                metric = str_const(name_arg)
+                if metric is not None:
+                    scan.registered.setdefault(metric, (sf.rel, node.lineno))
+                    if metric not in regs.readme_metrics:
+                        scan.findings.append(
+                            _finding(
+                                sf,
+                                "CON001",
+                                node,
+                                f"metric `{metric}` is registered here but "
+                                "has no row in the README metric glossary",
+                            )
+                        )
+                elif (p := _fstring_prefix(name_arg)) is not None:
+                    scan.dynamic_prefixes.add(p)
+                elif isinstance(name_arg, ast.Name):
+                    # table-driven loops (fleet beacons, xla_* gauges):
+                    # resolve what the variable ranges over, don't flag —
+                    # each resolved name counts as registered here
+                    for resolved in _loop_table_names(name_arg, sf):
+                        scan.registered.setdefault(
+                            resolved, (sf.rel, node.lineno)
+                        )
+            # --- fault sites --------------------------------------------
+            if (
+                name
+                and name.split(".")[-1] == "fault_point"
+                and node.args
+                and (site := str_const(node.args[0])) is not None
+                and not sf.rel.endswith("faults/inject.py")
+            ):
+                if site not in regs.known_sites:
+                    scan.findings.append(
+                        _finding(
+                            sf,
+                            "CON003",
+                            node,
+                            f"fault site `{site}` is not in "
+                            "faults.inject.KNOWN_SITES — a plan naming it "
+                            "can never fire (or the registry is stale)",
+                        )
+                    )
+            # --- journal events -----------------------------------------
+            emits_journal = name == "_emit" or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "event"
+                and "journal" in (dotted_name(node.func.value) or "").lower()
+            )
+            if (
+                emits_journal
+                and node.args
+                and (etype := str_const(node.args[0])) is not None
+                and not sf.rel.endswith("obs/journal.py")
+            ):
+                if etype not in regs.journal_events:
+                    scan.findings.append(
+                        _finding(
+                            sf,
+                            "CON002",
+                            node,
+                            f"journal event `{etype}` is not in "
+                            "obs.journal.JOURNAL_EVENTS — readers and "
+                            "doctors won't know this row",
+                        )
+                    )
+        # --- config keys in attribute chains:  <cfg>.<section>.<key> -----
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr in regs.config_fields
+            # a method call (cfg.mesh.validate_pipe()) is not a field read
+            and not (
+                isinstance(
+                    (call := getattr(node, "graftlint_parent", None)), ast.Call
+                )
+                and call.func is node
+            )
+        ):
+            base = node.value.value
+            base_name = (dotted_name(base) or "").split(".")[-1]
+            if base_name in ("cfg", "config", "_cfg"):
+                section = node.value.attr
+                fields = regs.config_fields.get(section, set())
+                if fields and node.attr not in fields:
+                    scan.findings.append(
+                        _finding(
+                            sf,
+                            "CON004",
+                            node,
+                            f"config key `{section}.{node.attr}` is not a "
+                            f"field of {_SECTION_FILES[section][1]} — "
+                            "load_config would reject it",
+                        )
+                    )
+    # --- plan strings and --set literals inside Python ------------------
+    for plan, node in _plan_literals(sf):
+        for site in _plan_sites(plan):
+            if regs.known_sites and site not in regs.known_sites:
+                scan.findings.append(
+                    _finding(
+                        sf,
+                        "CON003",
+                        node,
+                        f"fault plan names unknown site `{site}` "
+                        f"(plan: `{plan}`) — it will never fire",
+                    )
+                )
+    for node in ast.walk(sf.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and not _is_docstring(node)
+        ):
+            for m in _CONFIG_REF_RE.finditer(node.value):
+                section, key = m.group(1), m.group(2)
+                fields = regs.config_fields.get(section, set())
+                if fields and key not in fields:
+                    scan.findings.append(
+                        _finding(
+                            sf,
+                            "CON004",
+                            node,
+                            f"`--set {section}.{key}=...` names a key "
+                            f"{_SECTION_FILES[section][1]} doesn't have — "
+                            "load_config raises on it",
+                        )
+                    )
+
+
+def check_text_file(path: Path, rel: str, regs: Registries) -> list[Finding]:
+    """CON003/CON004 over non-Python carriers: CI workflow, README."""
+    findings: list[Finding] = []
+    try:
+        lines = path.read_text().splitlines()
+    except OSError:
+        return findings
+    for lineno, line in enumerate(lines, 1):
+        for m in _CONFIG_REF_RE.finditer(line):
+            section, key = m.group(1), m.group(2)
+            fields = regs.config_fields.get(section, set())
+            if fields and key not in fields:
+                findings.append(
+                    Finding(
+                        rule="CON004",
+                        path=rel,
+                        line=lineno,
+                        scope="<text>",
+                        message=(
+                            f"`{section}.{key}` is not a "
+                            f"{_SECTION_FILES[section][1]} field — this "
+                            "override/recipe line would be rejected"
+                        ),
+                        snippet=line.strip()[:120],
+                        checker=CHECKER,
+                    )
+                )
+        for m in _GRAFT_FAULTS_RE.finditer(line):
+            for site in _plan_sites(m.group(1)):
+                if regs.known_sites and site not in regs.known_sites:
+                    findings.append(
+                        Finding(
+                            rule="CON003",
+                            path=rel,
+                            line=lineno,
+                            scope="<text>",
+                            message=(
+                                f"GRAFT_FAULTS plan names unknown site "
+                                f"`{site}` — it will never fire"
+                            ),
+                            snippet=line.strip()[:120],
+                            checker=CHECKER,
+                        )
+                    )
+    return findings
+
+
+def full_repo_contracts(
+    root: Path, regs: Registries, scan: ContractScan
+) -> list[Finding]:
+    """Two-sided checks that only make sense over the whole tree."""
+    findings: list[Finding] = []
+    documented_only = regs.readme_metrics - set(scan.registered)
+    for name in sorted(documented_only):
+        if any(name.startswith(p) for p in scan.dynamic_prefixes):
+            continue
+        findings.append(
+            Finding(
+                rule="CON001",
+                path="README.md",
+                line=1,
+                scope="<glossary>",
+                message=(
+                    f"README glossary documents metric `{name}` but "
+                    "nothing registers it — stale row (delete it or "
+                    "restore the metric)"
+                ),
+                snippet=name,
+                checker=CHECKER,
+            )
+        )
+    for name, lineno in regs.readme_journal_rows:
+        if regs.journal_events and name not in regs.journal_events:
+            findings.append(
+                Finding(
+                    rule="CON002",
+                    path="README.md",
+                    line=lineno,
+                    scope="<journal-table>",
+                    message=(
+                        f"README journal table documents event `{name}` "
+                        "which is not in obs.journal.JOURNAL_EVENTS"
+                    ),
+                    snippet=name,
+                    checker=CHECKER,
+                )
+            )
+    for rel in (".github/workflows/ci.yml", "README.md"):
+        path = root / rel
+        if path.exists():
+            findings.extend(check_text_file(path, rel, regs))
+    return findings

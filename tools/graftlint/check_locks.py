@@ -1,0 +1,375 @@
+"""LCK — lock discipline across the thread-heavy serving/observability code.
+
+| Rule   | Claim |
+|--------|-------|
+| LCK001 | A blocking operation (XLA compile, ``Future.result``,
+|        | ``block_until_ready``, file I/O, ``sleep``, subprocess, timed
+|        | queue/event waits) runs while a known lock is held — every
+|        | waiter on that lock now waits on the slow thing too, and if the
+|        | blocked path ever re-enters the lock, it deadlocks. |
+| LCK002 | While holding lock L, a method of the same class that itself
+|        | acquires L is called — with non-reentrant ``threading.Lock``
+|        | this is the exact round-10 warmup deadlock (compile under the
+|        | engine lock calling back into ``_task()``, which takes it). |
+| LCK003 | The global lock-acquisition order graph has a cycle: somewhere
+|        | A is taken before B, somewhere else B before A — two threads on
+|        | those paths can deadlock. |
+| LCK004 | A ``yield`` inside a ``with <lock>:`` block — the lock stays
+|        | held across arbitrary caller code for an unbounded time. |
+
+Lock identity is syntactic and therefore conservative: ``self.X`` where
+``X = threading.Lock()`` (or ``lockwatch.lock(...)``) in the same class,
+module/local variables assigned the same way, and lock-returning helper
+methods whose name contains ``lock`` (the engine's per-key
+``_compile_lock(key)``). Only *known* locks produce findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.graftlint.astutil import (
+    SourceFile,
+    call_name,
+    dotted_name,
+    enclosing_scope,
+)
+from tools.graftlint.findings import Finding
+
+CHECKER = "lock discipline"
+
+_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "Lock",
+    "RLock",
+    "lockwatch.lock",
+}
+_FILE_IO_ATTRS = {
+    "write", "read", "flush", "fsync",
+    "read_text", "write_text", "read_bytes", "write_bytes",
+}
+_SUBPROCESS_CALLS = {
+    "subprocess.run", "subprocess.check_call", "subprocess.check_output",
+    "subprocess.call",
+}
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    return isinstance(node, ast.Call) and call_name(node) in _LOCK_CTORS
+
+
+def blocking_reason(node: ast.Call) -> str | None:
+    """Why this call blocks, or None. Names are chosen so that every hit
+    is blocking by construction (``re.compile`` is carved out; ``.lower``
+    and ``.join`` are skipped entirely for str false positives)."""
+    name = call_name(node)
+    if name in ("open", "sleep", "time.sleep"):
+        return f"`{name}()`"
+    if name in ("os.fsync", "os.fdatasync"):
+        return f"`{name}()` (disk flush)"
+    if name in _SUBPROCESS_CALLS:
+        return f"`{name}()` (subprocess)"
+    if name == "jax.block_until_ready":
+        return "`jax.block_until_ready` (device sync)"
+    if isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        if attr == "result":
+            return "`.result()` (future wait)"
+        if attr == "block_until_ready":
+            return "`.block_until_ready()` (device sync)"
+        if attr == "compile" and name != "re.compile":
+            return "`.compile()` (XLA compile)"
+        if attr in _FILE_IO_ATTRS:
+            return f"`.{attr}()` (file I/O)"
+        if attr == "wait":
+            return "`.wait()` (blocking wait)"
+        if attr == "get" and any(kw.arg == "timeout" for kw in node.keywords):
+            return "`.get(timeout=...)` (blocking queue get)"
+    return None
+
+
+@dataclass
+class _ModuleLocks:
+    """Known locks in one file, resolvable from a ``with`` item."""
+
+    rel: str
+    class_attr: dict[tuple[str, str], str] = field(default_factory=dict)
+    module_var: dict[str, str] = field(default_factory=dict)
+    local_var: dict[tuple[str, str], str] = field(default_factory=dict)
+
+    def resolve(self, expr: ast.expr, cls: str | None, scope: str) -> str | None:
+        # with self.X:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            return self.class_attr.get((cls, expr.attr))
+        # with X:  (module or local lock)
+        if isinstance(expr, ast.Name):
+            return self.local_var.get((scope, expr.id)) or self.module_var.get(
+                expr.id
+            )
+        # with self._compile_lock(key):  — a lock-returning helper
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+            base = expr.func.value
+            if (
+                isinstance(base, ast.Name)
+                and base.id == "self"
+                and "lock" in expr.func.attr.lower()
+                and cls is not None
+            ):
+                return f"{self.rel}:{cls}.{expr.func.attr}()"
+        return None
+
+
+def _collect_locks(sf: SourceFile) -> _ModuleLocks:
+    locks = _ModuleLocks(rel=sf.rel)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign) or not _is_lock_ctor(node.value):
+            continue
+        scope = enclosing_scope(node)
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                cls = scope.split(".")[0] if scope != "<module>" else ""
+                if cls:
+                    locks.class_attr[(cls, tgt.attr)] = (
+                        f"{sf.rel}:{cls}.{tgt.attr}"
+                    )
+            elif isinstance(tgt, ast.Name):
+                if scope == "<module>":
+                    locks.module_var[tgt.id] = f"{sf.rel}:{tgt.id}"
+                else:
+                    locks.local_var[(scope, tgt.id)] = (
+                        f"{sf.rel}:{scope}.{tgt.id}"
+                    )
+    return locks
+
+
+@dataclass
+class LockFacts:
+    """Per-file facts the cross-file order graph is assembled from."""
+
+    findings: list[Finding] = field(default_factory=list)
+    # (holder_lock_id, acquired_lock_id, rel, line) — A held when B taken
+    order_edges: list[tuple[str, str, str, int]] = field(default_factory=list)
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Walk one function body tracking the stack of held (known) locks."""
+
+    def __init__(self, sf, locks, cls, scope, facts, acquires_of):
+        self.sf = sf
+        self.locks = locks
+        self.cls = cls
+        self.scope = scope
+        self.facts = facts
+        self.acquires_of = acquires_of  # (cls, method) -> set[lock_id]
+        self.held: list[str] = []
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.facts.findings.append(
+            Finding(
+                rule=rule,
+                path=self.sf.rel,
+                line=node.lineno,
+                scope=self.scope,
+                message=message,
+                snippet=self.sf.snippet(node.lineno),
+                checker=CHECKER,
+            )
+        )
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: list[str] = []
+        for item in node.items:
+            lock_id = self.locks.resolve(
+                item.context_expr, self.cls, self.scope
+            )
+            if lock_id is None:
+                continue
+            if lock_id in self.held:
+                self._emit(
+                    "LCK002",
+                    node,
+                    f"re-acquire of non-reentrant lock `{lock_id}` already "
+                    "held by this frame — immediate self-deadlock",
+                )
+            for holder in self.held:
+                self.facts.order_edges.append(
+                    (holder, lock_id, self.sf.rel, node.lineno)
+                )
+            acquired.append(lock_id)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            reason = blocking_reason(node)
+            if reason is not None:
+                self._emit(
+                    "LCK001",
+                    node,
+                    f"blocking {reason} while holding `{self.held[-1]}` — "
+                    "every waiter on the lock now waits on this too",
+                )
+            # same-class method call while a lock of this class is held:
+            # LCK002 if the callee (directly) takes a held lock — the
+            # round-10 warmup-deadlock shape — plus order edges for any
+            # other lock it takes.
+            if (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and self.cls is not None
+            ):
+                callee = node.func.attr
+                for lock_id in sorted(
+                    self.acquires_of.get((self.cls, callee), ())
+                ):
+                    if lock_id in self.held:
+                        self._emit(
+                            "LCK002",
+                            node,
+                            f"`self.{callee}()` acquires `{lock_id}` which "
+                            "this frame already holds — non-reentrant "
+                            "deadlock (the round-10 warmup-hang class)",
+                        )
+                    else:
+                        for holder in self.held:
+                            self.facts.order_edges.append(
+                                (holder, lock_id, self.sf.rel, node.lineno)
+                            )
+        self.generic_visit(node)
+
+    def _yield_check(self, node: ast.AST) -> None:
+        if self.held:
+            self._emit(
+                "LCK004",
+                node,
+                f"`yield` while holding `{self.held[-1]}` — the lock stays "
+                "held across arbitrary caller code until the generator "
+                "resumes",
+            )
+
+    def visit_Yield(self, node: ast.Yield) -> None:
+        self._yield_check(node)
+        self.generic_visit(node)
+
+    def visit_YieldFrom(self, node: ast.YieldFrom) -> None:
+        self._yield_check(node)
+        self.generic_visit(node)
+
+    # a nested def is a new frame: it does not inherit held locks at its
+    # *definition* site (it may run anywhere), so scan it independently
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _direct_acquires(func: ast.FunctionDef, locks, cls, scope) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lock_id = locks.resolve(item.context_expr, cls, scope)
+                if lock_id is not None:
+                    out.add(lock_id)
+    return out
+
+
+def check_locks(sf: SourceFile) -> LockFacts:
+    facts = LockFacts()
+    locks = _collect_locks(sf)
+
+    # pass 1: which locks does each (class, method) acquire directly?
+    acquires_of: dict[tuple[str, str], set[str]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    scope = f"{node.name}.{item.name}"
+                    acquires_of[(node.name, item.name)] = _direct_acquires(
+                        item, locks, node.name, scope
+                    )
+
+    # pass 2: scan every function with the held-lock stack
+    def scan(func: ast.FunctionDef, cls: str | None, scope: str) -> None:
+        scanner = _FunctionScanner(sf, locks, cls, scope, facts, acquires_of)
+        for stmt in func.body:
+            scanner.visit(stmt)
+
+    for node in sf.tree.body:
+        if isinstance(node, ast.FunctionDef):
+            scan(node, None, node.name)
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    scan(item, node.name, f"{node.name}.{item.name}")
+    return facts
+
+
+def order_graph_findings(
+    all_edges: list[tuple[str, str, str, int]]
+) -> list[Finding]:
+    """LCK003: cycles in the global (cross-file) acquisition order graph."""
+    adj: dict[str, dict[str, tuple[str, int]]] = {}
+    for a, b, rel, line in all_edges:
+        if a != b:
+            adj.setdefault(a, {}).setdefault(b, (rel, line))
+    findings: list[Finding] = []
+    seen_cycles: set[frozenset[str]] = set()
+
+    def dfs(start: str) -> None:
+        stack: list[str] = [start]
+        on_path = {start}
+
+        def walk(cur: str) -> None:
+            for nxt in adj.get(cur, {}):
+                if nxt == start and len(stack) > 1:
+                    cyc = frozenset(stack)
+                    if cyc not in seen_cycles:
+                        seen_cycles.add(cyc)
+                        rel, line = adj[stack[-1]][start]
+                        chain = " → ".join(stack + [start])
+                        findings.append(
+                            Finding(
+                                rule="LCK003",
+                                path=rel,
+                                line=line,
+                                scope="<order-graph>",
+                                message=(
+                                    f"lock-order cycle: {chain} — two "
+                                    "threads taking these locks in the "
+                                    "two observed orders can deadlock"
+                                ),
+                                snippet=chain,
+                                checker=CHECKER,
+                            )
+                        )
+                elif nxt not in on_path:
+                    stack.append(nxt)
+                    on_path.add(nxt)
+                    walk(nxt)
+                    on_path.discard(nxt)
+                    stack.pop()
+
+        walk(start)
+
+    for node in sorted(adj):
+        dfs(node)
+    return findings

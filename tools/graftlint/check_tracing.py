@@ -1,0 +1,237 @@
+"""TRC — JAX tracing hazards inside jit-compiled functions.
+
+| Rule   | Claim |
+|--------|-------|
+| TRC001 | Python ``if``/``while``/``assert`` on a traced value (a non-static
+|        | parameter of a jitted function) — tracing turns these into
+|        | ``ConcretizationTypeError`` or, worse, a silently frozen branch. |
+| TRC002 | Host sync inside jitted code: ``float()``/``int()``/``bool()`` on
+|        | a traced value, ``.item()``, ``np.asarray``/``np.array`` of a
+|        | traced value, ``jax.device_get`` — each blocks dispatch on device
+|        | completion and bakes one traced value into the program. |
+| TRC003 | Wall-clock or host RNG inside jitted code (``time.time`` etc.,
+|        | ``random.*``, ``np.random.*``) — traced once, constant forever. |
+| TRC004 | A jitted function with hashable config parameters (str/bool
+|        | defaults) not pinned by ``static_argnames`` — passing a different
+|        | value silently retraces (or fails) instead of recompiling once
+|        | per config. |
+
+Scope is deliberately *jitted bodies only* (decorated with ``jax.jit`` /
+``partial(jax.jit, ...)`` or passed module-locally to ``jax.jit(...)``),
+including defs nested inside them: that is where the claims above are
+true by construction, so every hit is a real hazard, not a style nit.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.astutil import (
+    JitInfo,
+    SourceFile,
+    call_name,
+    dotted_name,
+    find_jitted_functions,
+    param_names,
+    parents,
+)
+from tools.graftlint.findings import Finding
+
+CHECKER = "JAX tracing hazards"
+
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic", "time.time_ns"}
+_CAST_CALLS = {"float", "int", "bool"}
+_HOST_FETCH_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_DEVICE_GET = {"jax.device_get", "jax.block_until_ready"}
+
+
+def _expr_roots(node: ast.AST) -> set[str]:
+    """Base ``Name`` ids that Name/Attribute/Subscript chains hang off."""
+    roots: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            roots.add(n.id)
+    return roots
+
+
+def _is_none_check(test: ast.expr) -> bool:
+    """``x is None`` / ``x is not None`` — static under tracing."""
+    if isinstance(test, ast.Compare):
+        return all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ) and any(
+            isinstance(c, ast.Constant) and c.value is None
+            for c in test.comparators
+        )
+    return False
+
+
+def _uses_isinstance(test: ast.expr) -> bool:
+    return any(
+        isinstance(n, ast.Call) and call_name(n) == "isinstance"
+        for n in ast.walk(test)
+    )
+
+
+def _traced_names(info: JitInfo) -> set[str]:
+    """Parameters carrying traced arrays: the jitted function's own plus
+    any def nested inside it (closures stay traced), minus static ones."""
+    traced = set(param_names(info.func))
+    for node in ast.walk(info.func):
+        if isinstance(node, ast.FunctionDef) and node is not info.func:
+            traced |= set(param_names(node))
+    return traced - info.static_names
+
+
+def check_tracing(sf: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    for info in find_jitted_functions(sf):
+        traced = _traced_names(info)
+        top = info.func
+
+        def emit(rule: str, node: ast.AST, message: str) -> None:
+            findings.append(
+                Finding(
+                    rule=rule,
+                    path=sf.rel,
+                    line=node.lineno,
+                    scope=f"{top.name}",
+                    message=message,
+                    snippet=sf.snippet(node.lineno),
+                    checker=CHECKER,
+                )
+            )
+
+        for node in ast.walk(top):
+            # -- TRC001: control flow on traced values ------------------
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                if (
+                    not _is_none_check(test)
+                    and not _uses_isinstance(test)
+                    and _expr_roots(test) & traced
+                ):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    emit(
+                        "TRC001",
+                        node,
+                        f"Python `{kind}` on traced value(s) "
+                        f"{sorted(_expr_roots(test) & traced)} inside "
+                        f"jitted `{top.name}` — use lax.cond/lax.while_loop "
+                        "or pin the argument with static_argnames",
+                    )
+            elif isinstance(node, ast.Assert):
+                if _expr_roots(node.test) & traced:
+                    emit(
+                        "TRC001",
+                        node,
+                        f"`assert` on traced value(s) "
+                        f"{sorted(_expr_roots(node.test) & traced)} inside "
+                        f"jitted `{top.name}` — asserts concretize; use "
+                        "checkify or validate before the jit boundary",
+                    )
+            # -- TRC002 / TRC003: host syncs and host clocks ------------
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _CAST_CALLS and node.args:
+                    if _expr_roots(node.args[0]) & traced:
+                        emit(
+                            "TRC002",
+                            node,
+                            f"`{name}()` of a traced value inside jitted "
+                            f"`{top.name}` forces a host sync (blocks on "
+                            "device, concretizes the tracer)",
+                        )
+                elif name in _HOST_FETCH_CALLS and node.args:
+                    if _expr_roots(node.args[0]) & traced:
+                        emit(
+                            "TRC002",
+                            node,
+                            f"`{name}` of a traced value inside jitted "
+                            f"`{top.name}` copies device→host mid-program; "
+                            "use jnp inside jit, fetch after dispatch",
+                        )
+                elif name in _DEVICE_GET:
+                    emit(
+                        "TRC002",
+                        node,
+                        f"`{name}` inside jitted `{top.name}` is a host "
+                        "sync; move the fetch outside the jit boundary",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                ):
+                    emit(
+                        "TRC002",
+                        node,
+                        f"`.item()` inside jitted `{top.name}` blocks on "
+                        "the device and concretizes — return the array and "
+                        "fetch at the call site",
+                    )
+                elif name in _TIME_CALLS:
+                    emit(
+                        "TRC003",
+                        node,
+                        f"`{name}()` inside jitted `{top.name}` is traced "
+                        "ONCE and frozen into the executable — time on the "
+                        "host, pass values in as arguments",
+                    )
+                elif name and name.split(".")[0] == "random":
+                    emit(
+                        "TRC003",
+                        node,
+                        f"host `{name}` inside jitted `{top.name}` freezes "
+                        "one draw into the program — use jax.random with a "
+                        "traced key",
+                    )
+                elif name and name.split(".")[:2] in (
+                    ["np", "random"],
+                    ["numpy", "random"],
+                ):
+                    emit(
+                        "TRC003",
+                        node,
+                        f"`{name}` inside jitted `{top.name}` freezes one "
+                        "draw into the program — use jax.random with a "
+                        "traced key",
+                    )
+        # -- TRC004: config-shaped params without static_argnames -------
+        has_static_nums = info.jit_call is not None and any(
+            kw.arg == "static_argnums" for kw in info.jit_call.keywords
+        )
+        if not has_static_nums:
+            args = top.args
+            pos = args.posonlyargs + args.args
+            defaults = [None] * (len(pos) - len(args.defaults)) + list(
+                args.defaults
+            )
+            for arg, default in list(zip(pos, defaults)) + list(
+                zip(args.kwonlyargs, args.kw_defaults)
+            ):
+                if (
+                    isinstance(default, ast.Constant)
+                    and isinstance(default.value, (str, bool))
+                    and arg.arg not in info.static_names
+                ):
+                    anchor = info.jit_call if info.jit_call is not None else top
+                    findings.append(
+                        Finding(
+                            rule="TRC004",
+                            path=sf.rel,
+                            line=anchor.lineno,
+                            scope=top.name,
+                            message=(
+                                f"jitted `{top.name}` takes config-shaped "
+                                f"parameter `{arg.arg}` (default "
+                                f"{default.value!r}) without "
+                                "static_argnames — a non-array value "
+                                "traces as a constant or fails; pin it "
+                                f"static_argnames=('{arg.arg}',)"
+                            ),
+                            snippet=sf.snippet(anchor.lineno),
+                            checker=CHECKER,
+                        )
+                    )
+    return findings

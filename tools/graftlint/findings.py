@@ -1,0 +1,142 @@
+"""Finding model, baseline mechanics, and the doctor-style report.
+
+A finding's **baseline key** must survive unrelated edits: line numbers
+drift every PR, so the key is built from what the finding *is* — rule id,
+repo-relative path, the enclosing scope (``Class.method`` or
+``<module>``), and a short hash of the stripped source line. Accepting a
+finding means writing that key plus a human reason into
+``.graftlint-baseline.json``; the entry silently expires when the
+offending line changes or disappears (stale entries are reported so the
+baseline can't accumulate dead weight).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 2
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str            # e.g. "LCK001"
+    path: str            # repo-relative, posix separators
+    line: int            # 1-based
+    scope: str           # "Class.method", "function", or "<module>"
+    message: str         # one-sentence defect statement
+    snippet: str = ""    # stripped source line (keys the baseline hash)
+    checker: str = field(default="", compare=False)  # family display name
+
+    @property
+    def key(self) -> str:
+        digest = hashlib.sha1(self.snippet.encode()).hexdigest()[:12]
+        return f"{self.rule}|{self.path}|{self.scope}|{digest}"
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+class Baseline:
+    """Accepted findings: ``{key: {"reason": str}}`` under ``findings``."""
+
+    def __init__(self, entries: dict[str, dict] | None = None):
+        self.entries = entries or {}
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls()
+        doc = json.loads(path.read_text())
+        entries = doc.get("findings", {})
+        missing = [k for k, v in entries.items() if not v.get("reason")]
+        if missing:
+            raise ValueError(
+                f"{path}: baseline entries without a reason: {missing} — "
+                "every accepted finding must say why"
+            )
+        return cls(entries)
+
+    def accepts(self, finding: Finding) -> bool:
+        return finding.key in self.entries
+
+    def stale_keys(self, findings: list[Finding]) -> list[str]:
+        live = {f.key for f in findings}
+        return sorted(k for k in self.entries if k not in live)
+
+    @staticmethod
+    def render(findings: list[Finding], reason: str) -> str:
+        doc = {
+            "findings": {
+                f.key: {
+                    "reason": reason,
+                    "location": f.location(),
+                    "message": f.message,
+                }
+                for f in sorted(findings, key=lambda f: f.key)
+            }
+        }
+        return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def split_by_baseline(
+    findings: list[Finding], baseline: Baseline
+) -> tuple[list[Finding], list[Finding]]:
+    """(unbaselined, accepted) — unbaselined findings gate the exit code."""
+    fresh = [f for f in findings if not baseline.accepts(f)]
+    accepted = [f for f in findings if baseline.accepts(f)]
+    return fresh, accepted
+
+
+def render_report(
+    fresh: list[Finding],
+    accepted: list[Finding],
+    stale: list[str],
+    *,
+    files_scanned: int,
+) -> str:
+    """Doctor-style markdown: verdict first, then findings grouped by rule."""
+    lines = ["# graftlint report", ""]
+    verdict = (
+        "CLEAN" if not fresh else f"{len(fresh)} unbaselined finding(s)"
+    )
+    lines += [
+        f"**Verdict: {verdict}** — {files_scanned} file(s) scanned, "
+        f"{len(accepted)} baselined, {len(stale)} stale baseline entr"
+        f"{'y' if len(stale) == 1 else 'ies'}.",
+        "",
+    ]
+    if fresh:
+        lines += ["## Findings", ""]
+        by_rule: dict[str, list[Finding]] = {}
+        for f in fresh:
+            by_rule.setdefault(f.rule, []).append(f)
+        for rule in sorted(by_rule):
+            fs = sorted(by_rule[rule], key=lambda f: (f.path, f.line))
+            lines.append(f"### {rule} — {fs[0].checker or 'graftlint'}")
+            lines.append("")
+            for f in fs:
+                lines.append(f"- `{f.location()}` ({f.scope}): {f.message}")
+                if f.snippet:
+                    lines.append(f"  - `{f.snippet}`")
+                lines.append(f"  - baseline key: `{f.key}`")
+            lines.append("")
+    if accepted:
+        lines += ["## Baselined (accepted)", ""]
+        for f in sorted(accepted, key=lambda f: (f.path, f.line)):
+            lines.append(f"- `{f.location()}` {f.rule}: {f.message}")
+        lines.append("")
+    if stale:
+        lines += [
+            "## Stale baseline entries",
+            "",
+            "These keys no longer match any finding — the offending line "
+            "changed or was fixed. Delete them from the baseline.",
+            "",
+        ]
+        lines += [f"- `{k}`" for k in stale]
+        lines.append("")
+    return "\n".join(lines)
