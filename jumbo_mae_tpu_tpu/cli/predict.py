@@ -204,6 +204,15 @@ def build_parser() -> argparse.ArgumentParser:
         "the encoder (shared mask mode only)",
     )
     p.add_argument(
+        "--encoder-cache-mb",
+        type=float,
+        default=0.0,
+        metavar="MB",
+        help="byte bound on the encoder-output LRU on top of "
+        "--encoder-cache: whichever cap trips first evicts "
+        "(0 = entries-only)",
+    )
+    p.add_argument(
         "--metrics-port",
         type=int,
         default=None,
@@ -255,6 +264,19 @@ def main(argv: list[str] | None = None) -> Path | None:
         telemetry = TelemetryServer(health=health, port=args.metrics_port).start()
         print(f"[predict] exporter on :{telemetry.port} (/metrics, /healthz)")
 
+    # memory observability (obs/memwatch.py): sampled per /metrics scrape —
+    # device/host gauges, per-component byte accounting of the serving
+    # caches, and the HBM predict-vs-measured drift per compiled executable
+    memwatch = None
+    mem_accountant = None
+    if telemetry is not None and cfg.run.memwatch:
+        from jumbo_mae_tpu_tpu.obs.memwatch import MemAccountant, MemoryWatcher
+        from jumbo_mae_tpu_tpu.obs.perfmodel import detect_chip
+
+        mem_accountant = MemAccountant()
+        memwatch = MemoryWatcher(accountant=mem_accountant, chip=detect_chip())
+        health.probe("memory", memwatch.last_sample)
+
     replicated = bool(args.serve and args.replicas > 0)
     # restarts and promoted swaps read the checkpoint through this cell,
     # so a replica rebuilt after a promote comes up on the new weights
@@ -283,6 +305,7 @@ def main(argv: list[str] | None = None) -> Path | None:
                 else True
             ),
             encoder_cache=args.encoder_cache,
+            encoder_cache_bytes=int(args.encoder_cache_mb * 1024 * 1024),
         )
 
     if args.ckpt == "":
@@ -299,6 +322,24 @@ def main(argv: list[str] | None = None) -> Path | None:
                 f"[predict] warmup: {n_compiles} executable(s) compiled, "
                 f"{hits} loaded from warmcache"
             )
+    if memwatch is not None and engine is not None:
+        mem_accountant.register("engine_enc_cache", engine.encoder_cache_bytes)
+        mem_accountant.register(
+            "engine_exec_cache", engine.executable_cache_bytes
+        )
+        if engine.warmcache is not None:
+            mem_accountant.register(
+                "warmcache_disk", engine.warmcache.disk_bytes
+            )
+
+        def _sync_predicted_peaks(eng=engine):
+            # executables compile lazily on the request path too — refresh
+            # the prediction side of the drift gauge before every scrape
+            for prog, peak in eng.predicted_peak_hbm().items():
+                memwatch.record_predicted_peak(prog, peak)
+
+        telemetry.add_pre_scrape(_sync_predicted_peaks)
+        telemetry.add_pre_scrape(memwatch.sample)
     if health is not None and not replicated:
         health.set_ready(
             True, detail=f"engine up (ckpt={'yes' if args.ckpt else 'random'})"
@@ -398,6 +439,35 @@ def main(argv: list[str] | None = None) -> Path | None:
         eng0 = rs.replica(0).engine
         if eng0.warmcache is not None:
             print(f"[predict] warmcache: {eng0.warmcache.root}")
+        if memwatch is not None:
+            # per-replica accounting: probes resolve the CURRENT engine at
+            # sample time, so restarted/rebuilt replicas stay accounted
+            for i in range(args.replicas):
+                mem_accountant.register(
+                    f"replica{i}_enc_cache",
+                    lambda i=i: rs.replica(i).engine.encoder_cache_bytes(),
+                )
+                mem_accountant.register(
+                    f"replica{i}_exec_cache",
+                    lambda i=i: rs.replica(i).engine.executable_cache_bytes(),
+                )
+            if eng0.warmcache is not None:
+                mem_accountant.register(
+                    "warmcache_disk",
+                    lambda: rs.replica(0).engine.warmcache.disk_bytes(),
+                )
+
+            def _sync_replica_peaks():
+                for i in range(args.replicas):
+                    try:
+                        peaks = rs.replica(i).engine.predicted_peak_hbm()
+                    except Exception:  # noqa: BLE001 — replica mid-restart
+                        continue
+                    for prog, peak in peaks.items():
+                        memwatch.record_predicted_peak(prog, peak)
+
+            telemetry.add_pre_scrape(_sync_replica_peaks)
+            telemetry.add_pre_scrape(memwatch.sample)
         print(
             f"[predict] replica pool: {args.replicas} replicas, "
             f"quorum {rs.quorum}"
@@ -417,6 +487,11 @@ def main(argv: list[str] | None = None) -> Path | None:
                 canary_requests=args.swap_canary_requests,
                 canary_timeout_s=args.swap_canary_timeout_s,
                 on_promote=lambda c: ckpt_ref.__setitem__("ckpt", c),
+                # refuse a push the double-buffered restore cannot fit:
+                # rejected at the "headroom" stage before any replica flips
+                headroom_fn=(
+                    memwatch.headroom_check if memwatch is not None else None
+                ),
             )
         engine = eng0  # image geometry below; requests go through the pool
 
@@ -569,6 +644,10 @@ def main(argv: list[str] | None = None) -> Path | None:
                 # live autoscaler snapshot (queue depth / occupancy / shed
                 # rate) in the /healthz info payload while serving
                 health.probe("serving", mb.stats)
+            if mem_accountant is not None:
+                mem_accountant.register(
+                    "batcher_queue", lambda: mb.stats()["queue_bytes"]
+                )
             if slo_tracker is not None:
                 # ...and the same signals as slo_* gauges per scrape
                 slo_tracker.add_probe(

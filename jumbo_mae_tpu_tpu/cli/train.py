@@ -46,7 +46,9 @@ from jumbo_mae_tpu_tpu.faults import (
     SentinelConfig,
     fault_point,
     faults_active,
+    host_leak_tick,
     install_plan,
+    leak_ballast_bytes,
     set_host_index,
 )
 from jumbo_mae_tpu_tpu.models import (
@@ -87,6 +89,11 @@ from jumbo_mae_tpu_tpu.obs.costmodel import (
     extract_cost,
     publish_cost,
     utilization_report,
+)
+from jumbo_mae_tpu_tpu.obs.memwatch import (
+    LeakSentinel,
+    MemAccountant,
+    MemoryWatcher,
 )
 from jumbo_mae_tpu_tpu.obs.perfmodel import detect_chip, publish_drift, roofline
 from jumbo_mae_tpu_tpu.utils import (
@@ -854,6 +861,29 @@ def train(cfg: TrainConfig) -> dict:
     # journaled, and folded into the MFU/HFU split + drift gauge below.
     step_cost = None  # None = not yet extracted, False = gave up
     chip = detect_chip()
+    # memory observability (obs/memwatch.py): log-boundary device/host
+    # samples + per-component byte accounting + the leak sentinel. The
+    # fault ballast probe makes the injected host.leak chaos site show up
+    # as a *named* component in the verdict, closing the loop the CI
+    # mem-smoke asserts.
+    memwatch = None
+    leak_sentinel = None
+    if run.memwatch:
+        accountant = MemAccountant()
+        accountant.register("fault_ballast", leak_ballast_bytes)
+        if flightrec is not None:
+            accountant.register("flightrec_ring", flightrec.ring_bytes)
+        if journal is not None:
+            accountant.register(
+                "journal_file", lambda: journal.path.stat().st_size
+            )
+        memwatch = MemoryWatcher(accountant=accountant, chip=chip)
+        leak_sentinel = LeakSentinel(
+            window=run.memwatch_leak_window,
+            min_growth_mb=run.memwatch_leak_mb,
+        )
+        health.probe("memory", memwatch.last_sample)
+        health.degraded_when(leak_sentinel.degraded)
     sp_wait = span_timer("data_wait")
     sp_step = span_timer("train_step")
     sp_ckpt = span_timer("checkpoint_save")
@@ -893,6 +923,10 @@ def train(cfg: TrainConfig) -> dict:
                 # branch costs nothing when no plan is active
                 inject = None
                 if faults_active():
+                    # host.leak chaos site: corrupt(n) retains n MB/step in
+                    # the module ballast (the leak sentinel's test fixture);
+                    # a raise action models "the leak got fixed" and clears
+                    host_leak_tick(key=str(step))
                     lm = fault_point("train.loss", key=str(step), data=1.0)
                     gm = fault_point("train.grad", key=str(step), data=1.0)
                     if (lm, gm) != (1.0, 1.0):
@@ -1039,6 +1073,23 @@ def train(cfg: TrainConfig) -> dict:
                     now = time.perf_counter()
                     wait_frac = window_wait / max(now - window_t0, 1e-9)
                     g_wait_frac.set(wait_frac)
+                    # memory sample BEFORE the beacon write so this window's
+                    # rss/device-peak ride out in this window's beacon
+                    msnap = None
+                    if memwatch is not None:
+                        if step_cost:
+                            memwatch.record_predicted_peak(
+                                "train_step", step_cost.peak_bytes
+                            )
+                        msnap = memwatch.sample()
+                        if "rss_bytes" in msnap:
+                            beacon_stats["rss_bytes"] = int(msnap["rss_bytes"])
+                        if "device_peak_bytes" in msnap:
+                            beacon_stats["device_peak_bytes"] = int(
+                                msnap["device_peak_bytes"]
+                            )
+                        if "note" in msnap:
+                            print(f"[obs] {msnap['note']}")
                     if beacon is not None:
                         st = (now - window_t0) / max(window_steps, 1)
                         step_ema_s = (
@@ -1087,6 +1138,27 @@ def train(cfg: TrainConfig) -> dict:
                         if new_q:
                             seen_quarantine |= new_q
                             _emit("quarantine", shards=sorted(new_q))
+                    if msnap is not None:
+                        _emit(
+                            "mem_sample",
+                            step=step,
+                            **{k: v for k, v in msnap.items() if k != "ts"},
+                        )
+                        fired = (
+                            leak_sentinel.observe(msnap)
+                            if leak_sentinel is not None
+                            else None
+                        )
+                        if fired is not None:
+                            _emit("mem_leak_suspect", step=step, **fired)
+                            print(
+                                "[obs] WARNING: leak sentinel fired — "
+                                f"suspect {fired['component']} "
+                                f"(+{fired['robust_growth_bytes'] // (1024 * 1024)}"
+                                f" MiB robust growth over {fired['window']} "
+                                "samples); /healthz degraded"
+                            )
+                            _black_box("mem_leak", **fired)
                     # black box on the first bad window (edge-triggered: a long
                     # NaN streak is one incident, not a dump per log boundary)
                     if window_bad:

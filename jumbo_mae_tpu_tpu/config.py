@@ -131,6 +131,15 @@ class RunConfig:
     fleet_lag_steps: int = 2
     fleet_ratio: float = 1.5
     fleet_dead_after_s: float = 60.0
+    # memory observability (obs/memwatch.py): sample device/host memory per
+    # log window (and per /metrics scrape when serving), journal mem_sample
+    # snapshots, publish mem_* gauges, and run the leak sentinel — a robust
+    # RSS slope over memwatch_leak_window samples exceeding memwatch_leak_mb
+    # journals mem_leak_suspect naming the fastest-growing component, dumps
+    # the flight recorder, and latches /healthz degraded.
+    memwatch: bool = True
+    memwatch_leak_window: int = 12
+    memwatch_leak_mb: float = 32.0
     # serving SLOs (jumbo_mae_tpu_tpu/obs/slo.py): objectives like
     # "p99_latency_ms<=250;success_rate>=0.99" evaluated over a rolling
     # slow window with a fast confirmation window (0 = window_s / 12);

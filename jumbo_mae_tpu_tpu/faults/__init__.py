@@ -14,7 +14,9 @@ from jumbo_mae_tpu_tpu.faults.inject import (
     current_host_index,
     fault_point,
     faults_active,
+    host_leak_tick,
     install_plan,
+    leak_ballast_bytes,
     set_host_index,
 )
 from jumbo_mae_tpu_tpu.faults.sentinel import (
@@ -36,6 +38,8 @@ __all__ = [
     "fault_point",
     "faults_active",
     "guarded_apply_gradients",
+    "host_leak_tick",
     "install_plan",
+    "leak_ballast_bytes",
     "set_host_index",
 ]

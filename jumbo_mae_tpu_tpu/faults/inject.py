@@ -38,6 +38,7 @@ All selectors of a rule must match for it to fire. Examples::
     serve.replica:delay(5.0)@key~r2               # wedge replica r2 (hang path)
     ckpt.load:corrupt(4)                          # diverge a hot-swap restore
     data.decode:delay(0.2)@host=1                 # straggle host 1 of a pod
+    host.leak:corrupt(8)                          # leak 8 MB/step on the host
 
 The ``host=`` selector resolves the current process's host index lazily at
 fire time: an explicit :func:`set_host_index` (``cli/train.py`` pins it
@@ -47,7 +48,8 @@ else ``jax.process_index()`` when jax is already imported, else 0.
 
 Known sites (free-form names are allowed; these are the wired ones):
 ``data.shard_open``, ``data.decode``, ``train.loss``, ``train.grad``,
-``serve.submit``, ``serve.replica``, ``ckpt.save``, ``ckpt.load``.
+``serve.submit``, ``serve.replica``, ``ckpt.save``, ``ckpt.load``,
+``host.leak``.
 
 ``serve.replica`` fires at the top of each replica's batched predict with
 ``key`` = the replica name (``r0``, ``r1``, …), so ``key~`` targets one
@@ -56,7 +58,12 @@ is a hang. ``ckpt.load`` fires on the weight-swap restore path with the
 restored params tree as ``data`` — ``corrupt(k)`` sign-flips ``k``
 deterministically-chosen leaves so the parity gate sees a diverged model
 (a real bad-push, not a parse error), while ``raise`` models an unreadable
-checkpoint.
+checkpoint. ``host.leak`` is the memory-observability chaos site, ticked
+once per train step: ``corrupt(n)`` retains ``n`` MB in a module-level
+ballast list each time it fires (a controllable host leak the
+``LeakSentinel`` must catch and attribute), ``raise`` clears the ballast
+(the "leak fixed" edge); :func:`leak_ballast_bytes` is the accounting
+probe `obs/memwatch.py` registers so the attribution is testable.
 """
 
 from __future__ import annotations
@@ -95,6 +102,7 @@ KNOWN_SITES = (
     "serve.replica",
     "ckpt.save",
     "ckpt.load",
+    "host.leak",
 )
 
 
@@ -268,6 +276,12 @@ class FaultPlan:
             time.sleep(float(fired.arg))
             return data
         if fired.action == "corrupt":
+            if data is _LEAK_TOKEN:
+                # host.leak semantics: corrupt(n) has nothing to corrupt —
+                # it RETAINS n MB per firing in the module ballast, the
+                # controllable host leak the LeakSentinel must attribute
+                _LEAK_BALLAST.append(bytearray(int(fired.arg) * 1024 * 1024))
+                return data
             return _corrupt_bytes(data, int(fired.arg), self.seed, fired.hits)
         if fired.action == "nan":
             return float("nan")
@@ -311,6 +325,37 @@ def _corrupt_bytes(data, nbytes: int, seed: int, salt: int):
             out[i] = (-3.0 * arr - 0.5).astype(arr.dtype)
         return tree_util.tree_unflatten(treedef, out)
     return data
+
+
+# ------------------------------------------------------------ host ballast
+
+# The host.leak site's retained memory: every corrupt(n) firing appends an
+# n-MB buffer here; a raise firing clears it. Module-level on purpose —
+# a leak that vanished with its injector would be unmeasurable.
+_LEAK_BALLAST: list[bytearray] = []
+_LEAK_TOKEN = object()  # sentinel payload marking a host.leak tick
+
+
+def leak_ballast_bytes() -> int:
+    """Current bytes retained by the ``host.leak`` site — the accounting
+    probe ``obs/memwatch.py`` registers as the ``fault_ballast`` component
+    so the leak sentinel's attribution is chaos-testable."""
+    return sum(len(b) for b in _LEAK_BALLAST)
+
+
+def host_leak_tick(key: str | None = None) -> int:
+    """Tick the ``host.leak`` chaos site (once per train step).
+
+    ``corrupt(n)`` rules grow the module ballast by n MB per firing;
+    ``raise`` rules clear it (the fault's exception never propagates — a
+    *memory* fault must not crash the step loop). Returns the current
+    ballast size so the call site can assert/log it.
+    """
+    try:
+        fault_point("host.leak", key=key, data=_LEAK_TOKEN)
+    except Exception:  # noqa: BLE001 - raise action = "leak fixed", clear
+        _LEAK_BALLAST.clear()
+    return leak_ballast_bytes()
 
 
 # ------------------------------------------------------------ host identity
@@ -372,6 +417,7 @@ def install_plan(spec: "str | FaultPlan | None") -> FaultPlan | None:
     global _PLAN
     if spec is None or spec == "":
         _PLAN = None
+        _LEAK_BALLAST.clear()  # deactivation heals the injected leak
         return None
     plan = FaultPlan.parse(spec) if isinstance(spec, str) else spec
     _PLAN = plan

@@ -161,6 +161,7 @@ class MicroBatcher:
         )
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._depth = 0               # submitted, not yet popped by the loop
+        self._depth_bytes = 0         # payload bytes of those queued images
         self._submitted = 0           # lifetime submit attempts (incl. sheds)
         self._shed_n = 0              # lifetime QueueFullError sheds
         self._depth_lock = lockwatch.lock("batcher.depth")
@@ -198,6 +199,7 @@ class MicroBatcher:
             if self._tracer is not None
             else None
         )
+        arr = np.asarray(image)
         try:
             fault_point("serve.submit")
             if self._closed:
@@ -211,6 +213,7 @@ class MicroBatcher:
                         f"request queue full ({self._depth}/{self.max_queue})"
                     )
                 self._depth += 1
+                self._depth_bytes += arr.nbytes
         except BaseException as e:  # noqa: BLE001 — classify, trace, re-raise
             if tr is not None:
                 if isinstance(e, QueueFullError):
@@ -234,7 +237,7 @@ class MicroBatcher:
         # _flush): at CPU-smoke request rates even one observe per submit
         # is measurable; the depth lock above is one uncontended acquire
         self._q.put(
-            (np.asarray(image), fut, time.perf_counter(), deadline, tr, meta)
+            (arr, fut, time.perf_counter(), deadline, tr, meta)
         )
         return fut
 
@@ -247,6 +250,7 @@ class MicroBatcher:
         shaped for ``HealthState.probe()`` / ``SLOTracker`` probes."""
         with self._depth_lock:
             depth = self._depth
+            depth_bytes = self._depth_bytes
             submitted = self._submitted
             shed = self._shed_n
         sizes = self.batch_sizes
@@ -254,6 +258,7 @@ class MicroBatcher:
         mean = sum(sizes) / len(sizes) if sizes else 0.0
         return {
             "queue_depth": depth,
+            "queue_bytes": max(depth_bytes, 0),
             "batch_occupancy": round(last / self.max_batch, 4),
             "mean_batch_occupancy": round(mean / self.max_batch, 4),
             "requests_submitted": submitted,
@@ -294,7 +299,7 @@ class MicroBatcher:
                     continue
                 if item is _STOP:
                     continue
-                self._dec()
+                self._dec(item)
                 self._abort(item)
 
     def __enter__(self):
@@ -305,9 +310,11 @@ class MicroBatcher:
 
     # ---------------------------------------------------------- collector
 
-    def _dec(self):
+    def _dec(self, item=None):
         with self._depth_lock:
             self._depth -= 1
+            if item is not None:
+                self._depth_bytes -= item[0].nbytes
 
     def _abort(self, item):
         self._m_aborted.inc()
@@ -319,7 +326,7 @@ class MicroBatcher:
 
     def _admit(self, item, batch) -> None:
         """One popped request: shutdown-shed / deadline-expire / admit."""
-        self._dec()
+        self._dec(item)
         if self._closed and self._drain:
             self._abort(item)
             return

@@ -203,6 +203,7 @@ class InferenceEngine:
         quant: str | None = None,
         warm_cache: str | os.PathLike | bool | None = True,
         encoder_cache: int = 0,
+        encoder_cache_bytes: int = 0,
         on_compile: Callable[[str, int], None] | None = None,
         compile_cache: str | None = None,
         registry=None,
@@ -252,6 +253,10 @@ class InferenceEngine:
             "infer_encoder_cache_events_total",
             "reconstruction encoder-output LRU events",
             labels=("event",),
+        )
+        self._m_enc_cache_bytes = reg.gauge(
+            "infer_encoder_cache_bytes",
+            "resident bytes of cached encoder-output rows (tokens+mask+ids)",
         )
         self._m_quant = reg.gauge(
             "infer_quant_compression",
@@ -349,6 +354,11 @@ class InferenceEngine:
                 "per image)"
             )
         self._enc_cache_size = int(encoder_cache)
+        # optional byte bound on top of the entry bound: whichever trips
+        # first evicts. 0 = entries-only (historical behaviour). Only
+        # meaningful when encoder_cache > 0 enables the cache at all.
+        self._enc_cache_bytes_cap = int(encoder_cache_bytes)
+        self._enc_cache_nbytes = 0
         self._enc_cache: OrderedDict[str, tuple] = OrderedDict()
         self._enc_cache_lock = lockwatch.lock("engine.enc_cache")
         self.encoder_cache_hits = 0
@@ -372,6 +382,9 @@ class InferenceEngine:
         self.load_stats: dict[str, dict] = {}
         self._tasks: dict[str, dict] = {}  # task -> {model, variables, ...}
         self._exec: dict[tuple[str, int], Any] = {}
+        # serialized size per resident executable (where known) — summed by
+        # executable_cache_bytes() for the memory accountant
+        self._exec_nbytes: dict[tuple[str, int], int] = {}
         self.compile_counts: dict[tuple[str, int], int] = {}
         # XLA cost analysis per (task_key, bucket) + its roofline-predicted
         # execution seconds — filled at compile/warm-load time, read by the
@@ -548,6 +561,8 @@ class InferenceEngine:
         with self._enc_cache_lock:
             # cached encoder outputs are weight-dependent
             self._enc_cache.clear()
+            self._enc_cache_nbytes = 0
+            self._m_enc_cache_bytes.set(0)
         return snap
 
     def restore_snapshot(self, snap: dict) -> None:
@@ -565,6 +580,8 @@ class InferenceEngine:
                     del self._tasks[task]
         with self._enc_cache_lock:
             self._enc_cache.clear()
+            self._enc_cache_nbytes = 0
+            self._m_enc_cache_bytes.set(0)
 
     # ---------------------------------------------------- executable cache
 
@@ -761,6 +778,7 @@ class InferenceEngine:
                         size = float(meta.get("executable_bytes") or 0.0)
                         if size > 0:
                             self._m_exec_bytes.labels(*map(str, key)).set(size)
+                            self._exec_nbytes[key] = int(size)
                     return ex
             self._m_misses.labels(key[0]).inc()
             t_compile = time.perf_counter()
@@ -797,6 +815,7 @@ class InferenceEngine:
                 )
                 if size:
                     self._m_exec_bytes.labels(*map(str, key)).set(size)
+                    self._exec_nbytes[key] = int(size)
             return ex
 
     def _publish_cost(self, key: tuple[str, int], ex):
@@ -1021,14 +1040,46 @@ class InferenceEngine:
             "reconstruct", images, extra=(jnp.asarray(seed, jnp.int32),)
         )
 
+    @staticmethod
+    def _row_nbytes(row: tuple) -> int:
+        """Payload bytes of one cached (tokens, mask, ids) row."""
+        return sum(int(getattr(a, "nbytes", 0)) for a in row)
+
     def encoder_cache_stats(self) -> dict:
         with self._enc_cache_lock:
             size = len(self._enc_cache)
+            nbytes = self._enc_cache_nbytes
         return {
             "capacity": self._enc_cache_size,
+            "capacity_bytes": self._enc_cache_bytes_cap,
             "size": size,
+            "bytes": nbytes,
             "hits": self.encoder_cache_hits,
             "misses": self.encoder_cache_misses,
+        }
+
+    def encoder_cache_bytes(self) -> int:
+        """Resident payload bytes of the encoder-output LRU — the memory
+        accountant's ``engine_enc_cache`` component probe."""
+        with self._enc_cache_lock:
+            return self._enc_cache_nbytes
+
+    def executable_cache_bytes(self) -> int:
+        """Sum of known serialized sizes of resident executables — the
+        accountant's ``engine_exec_cache`` probe. Sizes come from warmcache
+        serialization; a compiled-but-never-serialized executable (warmcache
+        off) contributes 0 rather than guessing."""
+        return sum(self._exec_nbytes.values())
+
+    def predicted_peak_hbm(self) -> dict[str, float]:
+        """XLA-predicted peak HBM bytes per compiled program
+        (``task/b<bucket>`` keys) — feeds the serving-side
+        ``mem_hbm_predict_vs_measured`` drift gauge via
+        ``MemoryWatcher.record_predicted_peak``."""
+        return {
+            f"{k[0]}/b{k[1]}": float(c.peak_bytes)
+            for k, c in self.cost_reports.items()
+            if getattr(c, "peak_bytes", 0)
         }
 
     def _reconstruct_cached(self, images, seed: int) -> dict[str, np.ndarray]:
@@ -1088,11 +1139,23 @@ class InferenceEngine:
                     row = (tokens[j], mask[j], ids[j])
                     for i in idxs:
                         rows[i] = row
+                    if k not in self._enc_cache:
+                        self._enc_cache_nbytes += self._row_nbytes(row)
                     self._enc_cache[k] = row
                     self._enc_cache.move_to_end(k)
-                while len(self._enc_cache) > self._enc_cache_size:
-                    self._enc_cache.popitem(last=False)
+                # two bounds, one loop: entry count (historical) and, when
+                # configured, resident bytes — whichever trips first evicts
+                while self._enc_cache and (
+                    len(self._enc_cache) > self._enc_cache_size
+                    or (
+                        self._enc_cache_bytes_cap > 0
+                        and self._enc_cache_nbytes > self._enc_cache_bytes_cap
+                    )
+                ):
+                    _, old = self._enc_cache.popitem(last=False)
+                    self._enc_cache_nbytes -= self._row_nbytes(old)
                     self._m_enc_cache.labels("evict").inc()
+                self._m_enc_cache_bytes.set(self._enc_cache_nbytes)
         tokens = np.stack([r[0] for r in rows])
         mask = np.stack([r[1] for r in rows])
         ids = np.stack([r[2] for r in rows])

@@ -881,6 +881,7 @@ class WeightSwapController:
         canary_timeout_s: float = 30.0,
         drain_timeout_s: float = 10.0,
         on_promote=None,
+        headroom_fn=None,
         registry=None,
         clock: Callable[[], float] = time.monotonic,
     ):
@@ -896,6 +897,11 @@ class WeightSwapController:
         self.canary_timeout_s = float(canary_timeout_s)
         self.drain_timeout_s = float(drain_timeout_s)
         self._on_promote = on_promote
+        # optional memory gate (obs/memwatch.MemoryWatcher.headroom_check):
+        # called with the swap's double-buffer byte need (new tree + the
+        # rollback snapshot of the old one) AFTER restore succeeds, BEFORE
+        # any replica is touched — returns None (fits) or a reason string
+        self._headroom_fn = headroom_fn
         self._clock = clock
         self._swap_lock = lockwatch.lock("replicaset.swap")
         self.last_report: dict | None = None
@@ -914,7 +920,7 @@ class WeightSwapController:
         self._m_rejected = reg.counter(
             "serve_swap_rejected_total",
             "hot-swaps rejected before any replica was flipped "
-            "(restore/graft failure, no routable canary)",
+            "(restore/graft failure, memory headroom, no routable canary)",
         )
         self._m_active = reg.gauge(
             "serve_swap_active", "1 while a swap is in flight"
@@ -1001,6 +1007,18 @@ class WeightSwapController:
                 return self._reject(
                     report, "restore", f"{type(e).__name__}: {e}"
                 )
+            if self._headroom_fn is not None:
+                from jumbo_mae_tpu_tpu.obs.memwatch import tree_nbytes
+
+                # double buffer: the restored tree plus the snapshot of the
+                # old one both live until promote/rollback resolves
+                need = 2 * tree_nbytes(params)
+                try:
+                    shortfall = self._headroom_fn(need)
+                except Exception:  # noqa: BLE001 — a broken probe must not block swaps
+                    shortfall = None
+                if shortfall:
+                    return self._reject(report, "headroom", str(shortfall))
             canary = self.rs.first_routable()
             if canary is None:
                 return self._reject(report, "canary_pick", "no routable replica")

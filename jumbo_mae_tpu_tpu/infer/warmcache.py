@@ -95,6 +95,7 @@ class WarmCache:
         registry=None,
         quarantine_keep: int = 32,
         quarantine_max_age_s: float = 7 * 24 * 3600.0,
+        cache_max_bytes: int = 0,
     ):
         self.root = Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
@@ -111,17 +112,27 @@ class WarmCache:
             "infer_warmcache_quarantine_pruned_total",
             "quarantined entries deleted by the count/age cap",
         )
+        self._m_disk = registry.gauge(
+            "infer_warmcache_disk_bytes",
+            "on-disk bytes of main-dir cache entries + sidecars",
+        )
         self.quarantine_keep = int(quarantine_keep)
         self.quarantine_max_age_s = float(quarantine_max_age_s)
+        # optional byte bound on the MAIN dir (quarantine has its own
+        # count/age cap): oldest-mtime entries and their sidecars are
+        # deleted until the footprint fits. 0 = unbounded (historical).
+        self.cache_max_bytes = int(cache_max_bytes)
         self.hits = 0
         self.misses = 0
         self.puts = 0
         self.put_errors = 0
         self.quarantined = 0
         self.quarantine_pruned = 0
+        self.main_pruned = 0
         # claim-time sweep: whoever opens the cache dir pays the prune, so
         # the bound holds even if every previous process crashed mid-flight
         self._prune_quarantine()
+        self._prune_main()
 
     # ------------------------------------------------------------------ io
 
@@ -190,6 +201,9 @@ class WarmCache:
         self.puts += 1
         self._m.labels("put").inc()
         self._put_meta(name, {"executable_bytes": len(blob), **(meta or {})})
+        # re-enforce the byte bound (and refresh the disk gauge) after every
+        # publish — the writer pays for its own growth
+        self._prune_main()
         return len(blob)
 
     def _put_meta(self, name: str, meta: dict) -> None:
@@ -254,16 +268,66 @@ class WarmCache:
             self._m_pruned.inc(pruned)
         return pruned
 
+    def disk_bytes(self) -> int:
+        """Main-dir footprint in bytes (entries + sidecars + in-flight
+        tmps; ``quarantine/`` excluded — it has its own count/age bound).
+        The memory accountant's ``warmcache_disk`` component probe."""
+        total = 0
+        try:
+            for p in self.root.iterdir():
+                if not p.is_file():
+                    continue
+                try:
+                    total += p.stat().st_size
+                except OSError:
+                    continue
+        except OSError:
+            return 0
+        return total
+
+    def _prune_main(self) -> int:
+        """Enforce ``cache_max_bytes`` over the main dir, LRU by mtime:
+        oldest entries (and their sidecars) are deleted until the footprint
+        fits. Always refreshes ``infer_warmcache_disk_bytes``. Returns
+        entries deleted."""
+        pruned = 0
+        if self.cache_max_bytes > 0:
+            try:
+                entries = sorted(
+                    (p.stat().st_mtime, p) for p in self.root.glob("*.exe")
+                )
+            except OSError:
+                entries = []
+            total = self.disk_bytes()
+            for _mtime, path in entries:
+                if total <= self.cache_max_bytes:
+                    break
+                for victim in (path, self.root / f"{path.name}.meta.json"):
+                    try:
+                        size = victim.stat().st_size
+                        victim.unlink()
+                    except OSError:
+                        continue
+                    total -= size
+                pruned += 1
+                self._m.labels("pruned").inc()
+            if pruned:
+                self.main_pruned += pruned
+        self._m_disk.set(self.disk_bytes())
+        return pruned
+
     def stats(self) -> dict:
         return {
             "root": str(self.root),
             "entries": len(list(self.root.glob("*.exe"))),
+            "disk_bytes": self.disk_bytes(),
             "hits": self.hits,
             "misses": self.misses,
             "puts": self.puts,
             "put_errors": self.put_errors,
             "quarantined": self.quarantined,
             "quarantine_pruned": self.quarantine_pruned,
+            "main_pruned": self.main_pruned,
         }
 
 

@@ -27,6 +27,10 @@
   the host-0 aggregator (straggler/lost detection, ``fleet_*`` gauges).
 - ``obs.reqtrace`` — per-request trace context for the serving path + the
   crash-safe JSONL access log (``tools/serve_doctor.py`` reads it offline).
+- ``obs.memwatch`` — live memory observability: device/host sampling with
+  the HBM predict-vs-measured drift gauge, per-component byte accounting,
+  and the robust-slope leak sentinel (``tools/mem_doctor.py`` reads the
+  journaled samples offline).
 - ``obs.lockwatch`` — opt-in instrumented locks (``GRAFT_LOCKWATCH=1``):
   runtime lock-order inversion + long-hold detection, ``lock_*`` metrics,
   ``lock_order_violation`` journal events.
@@ -54,6 +58,14 @@ from jumbo_mae_tpu_tpu.obs.journal import (
     read_merged_journal,
 )
 from jumbo_mae_tpu_tpu.obs.lockwatch import WatchedLock
+from jumbo_mae_tpu_tpu.obs.memwatch import (
+    LeakSentinel,
+    MemAccountant,
+    MemoryWatcher,
+    host_available_bytes,
+    host_rss_bytes,
+    tree_nbytes,
+)
 from jumbo_mae_tpu_tpu.obs.retrace import RetraceSentinel
 from jumbo_mae_tpu_tpu.obs.modelstats import (
     STAT_NAMES,
@@ -149,6 +161,9 @@ __all__ = [
     "Histogram",
     "LATENCY_BUCKETS",
     "LEDGER_SCHEMA",
+    "LeakSentinel",
+    "MemAccountant",
+    "MemoryWatcher",
     "MetricsRegistry",
     "MfuReport",
     "NULL_REGISTRY",
@@ -188,6 +203,8 @@ __all__ = [
     "group_layout",
     "group_of",
     "group_stats",
+    "host_available_bytes",
+    "host_rss_bytes",
     "journal_dir",
     "lookup_peak_tflops",
     "make_row",
@@ -212,5 +229,6 @@ __all__ = [
     "stats_dict",
     "stop_chrome_trace",
     "trace",
+    "tree_nbytes",
     "utilization_report",
 ]

@@ -16,8 +16,11 @@ Protocol:
   rewritten atomically (tmp + ``os.replace``) at each step entry and log
   boundary. Schema: ``host``, ``pid``, ``hostname``, ``step``, ``heartbeat``
   (epoch seconds), ``step_time_ema_s``, ``data_wait_fraction``,
-  ``shard_retries``, ``shard_quarantines``, ``sentinel_bad_steps``. A reader
-  can never observe a torn beacon — only the previous or the next version.
+  ``shard_retries``, ``shard_quarantines``, ``sentinel_bad_steps``, plus
+  optional memory fields ``rss_bytes`` / ``device_peak_bytes`` (omitted
+  when unknown — readers must tolerate their absence, so old-schema
+  beacons keep parsing). A reader can never observe a torn beacon — only
+  the previous or the next version.
 - host 0 runs a :class:`FleetAggregator` that scans the beacon dir (at its
   own log boundaries and as an exporter pre-scrape hook), publishes
   ``fleet_*{host=}`` gauges, and drives a per-host status machine:
@@ -87,6 +90,16 @@ _BEACON_GAUGES = (
         "fleet_sentinel_bad_steps",
         "per-host non-finite/skipped steps seen by the sentinel",
     ),
+    (
+        "rss_bytes",
+        "fleet_rss_bytes",
+        "per-host resident set size from its beacon (memwatch sample)",
+    ),
+    (
+        "device_peak_bytes",
+        "fleet_device_peak_bytes",
+        "per-host high-water device (HBM) bytes from its beacon",
+    ),
 )
 
 
@@ -127,6 +140,8 @@ class HostBeacon:
         shard_retries: int = 0,
         shard_quarantines: int = 0,
         sentinel_bad_steps: int = 0,
+        rss_bytes: int | None = None,
+        device_peak_bytes: int | None = None,
         now: float | None = None,
         **extra,
     ) -> dict:
@@ -147,6 +162,13 @@ class HostBeacon:
             "shard_quarantines": int(shard_quarantines),
             "sentinel_bad_steps": int(sentinel_bad_steps),
         }
+        # memory fields are OPTIONAL schema: written only when known, so a
+        # beacon from a build/backend without memwatch stays byte-identical
+        # to the old schema and every reader keeps working
+        if rss_bytes is not None:
+            payload["rss_bytes"] = int(rss_bytes)
+        if device_peak_bytes is not None:
+            payload["device_peak_bytes"] = int(device_peak_bytes)
         payload.update(extra)
         self._tmp.write_text(json.dumps(payload, separators=(",", ":")))
         os.replace(self._tmp, self.path)
@@ -197,6 +219,8 @@ class FleetAggregator:
         lag_steps: int = 2,
         ratio: float = 1.5,
         dead_after_s: float = 60.0,
+        mem_ratio: float = 1.5,
+        mem_floor_bytes: int = 256 * 1024 * 1024,
         on_event=None,
         registry: MetricsRegistry | None = None,
     ):
@@ -205,6 +229,11 @@ class FleetAggregator:
         self.lag_steps = max(1, int(lag_steps))
         self.ratio = float(ratio)
         self.dead_after_s = float(dead_after_s)
+        # memory outlier: rss >= mem_ratio × fleet median AND the excess
+        # over the median clears an absolute floor — the ratio alone would
+        # flag noise on small-RSS smoke processes
+        self.mem_ratio = float(mem_ratio)
+        self.mem_floor_bytes = int(mem_floor_bytes)
         self.on_event = on_event  # on_event(etype, **payload) → journal
         reg = registry if registry is not None else get_registry()
         self._g_beacon = [
@@ -230,6 +259,12 @@ class FleetAggregator:
         self._g_up = reg.gauge(
             "fleet_host_up",
             "1 while this host's heartbeat is fresher than run.fleet_dead_after_s",
+            labels=("host",),
+        )
+        self._g_mem_outlier = reg.gauge(
+            "fleet_mem_outlier",
+            "1 while this host's beacon RSS is a fleet memory outlier "
+            "(>= mem_ratio x the fleet median, past the absolute floor)",
             labels=("host",),
         )
         self._g_alive = reg.gauge("fleet_hosts_alive", "hosts with a fresh heartbeat")
@@ -275,6 +310,12 @@ class FleetAggregator:
             if b.get("data_wait_fraction") is not None
         )
         median_wait = waits[(len(waits) - 1) // 2] if waits else 0.0
+        rsses = sorted(
+            float(b["rss_bytes"])
+            for b in alive.values()
+            if b.get("rss_bytes") is not None
+        )
+        median_rss = rsses[(len(rsses) - 1) // 2] if rsses else 0.0
 
         hosts: dict[int, dict] = {}
         events: list[tuple[str, dict]] = []
@@ -340,6 +381,17 @@ class FleetAggregator:
                             },
                         )
                     )
+            # memory outlier: a flag, not a status — a leaking host still
+            # makes lockstep progress, so it must not shadow straggler/lost
+            rss = b.get("rss_bytes")
+            mem_outlier = (
+                not lost
+                and len(alive) >= 2
+                and rss is not None
+                and median_rss > 0
+                and float(rss) >= self.mem_ratio * median_rss
+                and float(rss) - median_rss >= self.mem_floor_bytes
+            )
             self._status[h] = status
             hosts[h] = {
                 "status": status,
@@ -351,6 +403,13 @@ class FleetAggregator:
                 "shard_retries": int(b.get("shard_retries", 0) or 0),
                 "shard_quarantines": int(b.get("shard_quarantines", 0) or 0),
                 "sentinel_bad_steps": int(b.get("sentinel_bad_steps", 0) or 0),
+                "rss_bytes": None if rss is None else int(rss),
+                "device_peak_bytes": (
+                    None
+                    if b.get("device_peak_bytes") is None
+                    else int(b["device_peak_bytes"])
+                ),
+                "mem_outlier": bool(mem_outlier),
                 "symptom": symptom if status != self.OK else None,
             }
             # gauges (string label values per Prometheus convention)
@@ -363,6 +422,7 @@ class FleetAggregator:
             self._g_age.labels(host=hs).set(age)
             self._g_straggler.labels(host=hs).set(1 if status == self.STRAGGLER else 0)
             self._g_up.labels(host=hs).set(0 if lost else 1)
+            self._g_mem_outlier.labels(host=hs).set(1 if mem_outlier else 0)
 
         self._g_alive.set(len(alive))
         if self.expected_hosts is not None:
@@ -380,6 +440,7 @@ class FleetAggregator:
             "missing": missing,
             "stragglers": [h for h, s in hosts.items() if s["status"] == self.STRAGGLER],
             "lost": [h for h, s in hosts.items() if s["status"] == self.LOST],
+            "mem_outliers": [h for h, s in hosts.items() if s["mem_outlier"]],
         }
         summary["degraded"] = bool(summary["stragglers"] or summary["lost"])
         self._summary = summary

@@ -86,6 +86,18 @@ class FlightRecorder:
         dumps before exit, the atexit hook writes one last record."""
         self._abnormal = True
 
+    def ring_bytes(self) -> int:
+        """Shallow byte estimate of the in-memory rings — the accounting
+        probe ``obs/memwatch.py`` registers as the ``flightrec_ring``
+        component. Shallow ``getsizeof`` per entry (container overhead, not
+        deep payload bytes): cheap enough to run per log window, and it
+        tracks ring *growth*, which is all the leak sentinel needs."""
+        import sys as _sys
+
+        with self._lock:
+            entries = list(self._steps) + list(self._events)
+        return sum(_sys.getsizeof(e) for e in entries)
+
     # ------------------------------------------------------------- dumping
 
     @property

@@ -75,6 +75,8 @@ JOURNAL_EVENTS = frozenset(
         "fleet_host_rejoined",
         "retrace",
         "lock_order_violation",
+        "mem_sample",
+        "mem_leak_suspect",
     }
 )
 

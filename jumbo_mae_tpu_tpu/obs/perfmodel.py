@@ -54,9 +54,22 @@ ICI_GBPS = {
     "v6e": 200.0,
 }
 
+# HBM capacity GiB per chip (public spec sheets) — the denominator of
+# mem_doctor's OOM-risk estimate (measured peak / capacity).
+HBM_GIB = {
+    "v2": 8.0,
+    "v3": 16.0,
+    "v4": 32.0,
+    "v5e": 16.0,
+    "v5p": 95.0,
+    "v6e": 32.0,
+}
+
 # Order-of-magnitude generic host CPU: keeps the predict-vs-measured gauge
-# publishing on the smoke backend. Never used for capacity claims.
-GENERIC_CPU = ("cpu", 0.5, 20.0, 10.0)
+# publishing on the smoke backend. Never used for capacity claims —
+# capacity 0 means "no HBM to run out of", and consumers must skip the
+# OOM-risk math rather than divide by a made-up number.
+GENERIC_CPU = ("cpu", 0.5, 20.0, 10.0, 0.0)
 
 
 @dataclass(frozen=True)
@@ -65,6 +78,7 @@ class ChipSpec:
     peak_tflops: float
     hbm_gbps: float
     ici_gbps: float
+    hbm_bytes: float = 0.0  # capacity; 0 = unknown/not-an-accelerator
 
 
 def chip_spec(kind: str | None) -> ChipSpec:
@@ -73,7 +87,11 @@ def chip_spec(kind: str | None) -> ChipSpec:
     canon = normalize_device_kind(kind or "")
     if canon is not None and canon in HBM_GBPS:
         return ChipSpec(
-            canon, PEAK_TFLOPS[canon], HBM_GBPS[canon], ICI_GBPS[canon]
+            canon,
+            PEAK_TFLOPS[canon],
+            HBM_GBPS[canon],
+            ICI_GBPS[canon],
+            HBM_GIB[canon] * 1024**3,
         )
     return ChipSpec(*GENERIC_CPU)
 

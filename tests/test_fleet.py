@@ -510,3 +510,64 @@ def test_train_run_writes_beacon_and_fleet_doctor_reads_it(tmp_path):
     assert b["sentinel_bad_steps"] == 0
     assert doctor.main([str(run_dir), "--out", str(tmp_path / "fleet.md")]) == 0
     assert "fleet healthy" in (tmp_path / "fleet.md").read_text()
+
+
+# ------------------------------------------------------ memory beacon fields
+
+
+class TestFleetMemory:
+    def test_old_schema_beacons_parse_without_memory_fields(self, tmp_path):
+        """Forward-compat: beacons from writers that predate the memory
+        fields (no rss_bytes/device_peak_bytes) flow through the live
+        aggregator AND fleet_doctor's analyze without crashing/flagging."""
+        for h in (0, 1):
+            HostBeacon(tmp_path, host=h).write(step=10, now=T0)
+        for b in read_beacons(tmp_path).values():
+            assert "rss_bytes" not in b and "device_peak_bytes" not in b
+
+        agg, _ = _fleet(tmp_path, expected_hosts=2)
+        s = agg.scan(now=T0 + 1)
+        assert s["alive"] == 2 and s["mem_outliers"] == []
+        assert all(h["rss_bytes"] is None for h in s["hosts"].values())
+
+        import tools.fleet_doctor as doctor
+
+        res = doctor.analyze(read_beacons(tmp_path))
+        assert res["median_rss_bytes"] == 0
+        assert not any(h["mem_outlier"] for h in res["hosts"].values())
+
+    def test_memory_outlier_flagged_not_statused(self, tmp_path):
+        """A host far above the fleet-median RSS (>= ratio x median AND
+        past the absolute floor) is flagged as a memory outlier, while its
+        fleet status stays ok — memory skew is a flag, not a lifecycle."""
+        mib = 1024 * 1024
+        for h, rss in ((0, 1000 * mib), (1, 1000 * mib), (2, 2000 * mib)):
+            HostBeacon(tmp_path, host=h).write(
+                step=10, rss_bytes=rss, device_peak_bytes=rss // 2, now=T0
+            )
+        reg = MetricsRegistry()
+        agg, _ = _fleet(tmp_path, expected_hosts=3, registry=reg)
+        s = agg.scan(now=T0 + 1)
+        assert s["mem_outliers"] == [2]
+        assert s["hosts"][2]["mem_outlier"] and s["hosts"][2]["status"] == "ok"
+        assert not s["degraded"]  # outlier alone does not degrade the fleet
+        g = reg.gauge("fleet_mem_outlier", "x", labels=("host",))
+        assert g.labels(host="2").value == 1
+        assert g.labels(host="0").value == 0
+        # below the absolute floor the same ratio stays quiet (tiny fleet)
+        for h, rss in ((0, 10 * mib), (1, 10 * mib), (2, 20 * mib)):
+            HostBeacon(tmp_path, host=h).write(step=11, rss_bytes=rss, now=T0 + 2)
+        assert agg.scan(now=T0 + 3)["mem_outliers"] == []
+
+    def test_fleet_doctor_reports_memory_outlier(self, tmp_path, capsys):
+        mib = 1024 * 1024
+        fleet = tmp_path / "fleet"
+        for h, rss in ((0, 1000 * mib), (1, 1000 * mib), (2, 2000 * mib)):
+            HostBeacon(fleet, host=h).write(step=10, rss_bytes=rss, now=T0)
+        import tools.fleet_doctor as doctor
+
+        assert doctor.main([str(tmp_path)]) == 0
+        report = capsys.readouterr().out
+        assert "memory outlier: **host 2**" in report
+        assert "2000 MiB" in report and "1000 MiB" in report
+        assert "⚠ outlier" in report

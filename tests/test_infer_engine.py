@@ -494,6 +494,32 @@ def test_encoder_cache_evicts_lru():
     assert st["hits"] == 1 and st["misses"] == 4
 
 
+def test_encoder_cache_byte_cap_evicts_before_entry_cap():
+    """Regression: the byte cap is a real bound, not advisory — with a
+    generous entry cap and a tight byte cap, eviction happens on bytes."""
+    probe = InferenceEngine(tiny_cfg(), max_batch=4, encoder_cache=8)
+    probe.reconstruct(_images(1, seed=30), seed=0)
+    row_bytes = probe.encoder_cache_bytes()
+    assert row_bytes > 0
+
+    eng = InferenceEngine(
+        tiny_cfg(),
+        max_batch=4,
+        encoder_cache=64,  # entry cap alone would keep all three rows
+        encoder_cache_bytes=int(row_bytes * 1.5),  # byte cap holds one
+    )
+    for s in (30, 31, 32):
+        eng.reconstruct(_images(1, seed=s), seed=0)
+    st = eng.encoder_cache_stats()
+    assert st["capacity"] == 64 and st["capacity_bytes"] == int(row_bytes * 1.5)
+    assert st["size"] == 1 and st["misses"] == 3
+    assert 0 < st["bytes"] <= st["capacity_bytes"]
+    assert eng.encoder_cache_bytes() == row_bytes
+    # the survivor is the most recent row (LRU order held under byte evicts)
+    eng.reconstruct(_images(1, seed=32), seed=0)
+    assert eng.encoder_cache_stats()["hits"] == 1
+
+
 def test_encoder_cache_dedupes_within_batch():
     """Duplicate rows in ONE request encode once and decode per-row."""
     eng = InferenceEngine(tiny_cfg(), max_batch=4, encoder_cache=8)

@@ -1,0 +1,327 @@
+"""Memory observability contracts (obs/memwatch.py, tools/mem_doctor.py).
+
+The properties the subsystem stands on:
+
+- backends without ``memory_stats()`` (XLA:CPU) degrade to host-only
+  telemetry: the device/drift gauges are *absent from the scrape* (never
+  zero-valued), nothing crashes, and exactly one journal-able note marks
+  the degradation;
+- the accountant never lets a broken probe take down sampling;
+- the leak sentinel fires on sustained robust growth, stays quiet on flat
+  series with one-off spikes, names the fastest-growing component, and
+  demotes a minor grower to ``unaccounted``;
+- the ``host.leak`` chaos site grows/clears ballast exactly per the plan
+  grammar, so CI can inject a leak the sentinel must catch;
+- ``mem_doctor`` exits 2 naming the component on a leak incident, 0 on a
+  healthy run, 2 when there is nothing to diagnose.
+"""
+
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.faults import (
+    clear_plan,
+    host_leak_tick,
+    install_plan,
+    leak_ballast_bytes,
+)
+from jumbo_mae_tpu_tpu.obs.journal import RunJournal
+from jumbo_mae_tpu_tpu.obs.memwatch import (
+    MB,
+    LeakSentinel,
+    MemAccountant,
+    MemoryWatcher,
+    _theil_sen_slope,
+    tree_nbytes,
+)
+from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+
+# ------------------------------------------------------------- primitives
+
+
+def test_theil_sen_slope_linear_and_robust():
+    assert _theil_sen_slope([]) == 0.0
+    assert _theil_sen_slope([5.0]) == 0.0
+    assert _theil_sen_slope([3.0, 3.0, 3.0, 3.0]) == 0.0
+    assert _theil_sen_slope([0.0, 2.0, 4.0, 6.0]) == pytest.approx(2.0)
+    # one-off spike (an eval temp buffer) barely moves the median pairwise
+    # slope — the reason the sentinel uses it over least squares
+    spiked = [0.0, 1.0, 2.0, 100.0, 4.0, 5.0, 6.0, 7.0]
+    assert _theil_sen_slope(spiked) == pytest.approx(1.0, abs=0.35)
+
+
+def test_tree_nbytes_counts_arrays_ignores_scalars():
+    tree = {"a": np.zeros((4, 4), np.float32), "b": [np.zeros(8, np.int8), 3]}
+    assert tree_nbytes(tree) == 4 * 4 * 4 + 8
+    assert tree_nbytes(None) == 0
+
+
+# ------------------------------------------------------------- accountant
+
+
+def test_accountant_skips_broken_probes_and_publishes_gauge():
+    reg = MetricsRegistry()
+    acc = MemAccountant(registry=reg)
+    acc.register("good", lambda: 123)
+    acc.register("unknown", lambda: None)
+    acc.register("broken", lambda: 1 / 0)
+    assert acc.components() == ["broken", "good", "unknown"]
+    assert acc.sample() == {"good": 123}
+    assert 'mem_component_bytes{component="good"} 123' in reg.render()
+    acc.unregister("good")
+    assert acc.sample() == {}
+
+
+# -------------------------------------------------- watcher (CPU degrade)
+
+
+def test_watcher_degrades_on_cpu_without_device_gauges():
+    """Acceptance: on a backend without memory_stats, sampling works, host
+    gauges publish, device/drift gauges never appear in the scrape, and
+    the degradation note is one-shot."""
+    reg = MetricsRegistry()
+    acc = MemAccountant(registry=reg)
+    acc.register("ballast", leak_ballast_bytes)
+    w = MemoryWatcher(accountant=acc, registry=reg)
+    snap = w.sample()
+    assert w.device_stats_degraded  # XLA:CPU has no usable memory_stats
+    assert snap["rss_bytes"] > 0
+    assert snap["py_alloc_blocks"] > 0
+    assert "device_bytes" not in snap and "hbm_drift" not in snap
+    assert "memory_stats() unavailable" in snap["note"]
+    text = reg.render()
+    assert "mem_host_rss_bytes" in text
+    assert "mem_py_alloc_blocks" in text
+    assert "mem_device_bytes" not in text
+    assert "mem_hbm_predict_vs_measured" not in text
+    # the note is journaled once, not per sample
+    assert "note" not in w.sample()
+    assert w.last_sample()["rss_bytes"] > 0
+
+
+def test_watcher_publishes_device_and_drift_when_stats_exist(monkeypatch):
+    """With a backend that reports memory_stats (faked here), the lazy
+    device/drift gauges register and the drift ratio is measured/predicted."""
+    from jumbo_mae_tpu_tpu.obs import memwatch
+
+    monkeypatch.setattr(
+        memwatch,
+        "_device_memory_stats",
+        lambda: [("tpu:0", 600 * MB, 900 * MB), ("tpu:1", 500 * MB, 800 * MB)],
+    )
+    reg = MetricsRegistry()
+    w = MemoryWatcher(registry=reg)
+    w.record_predicted_peak("train_step", 1000 * MB)
+    w.record_predicted_peak("zero_is_ignored", 0)
+    w.record_predicted_peak("none_is_ignored", None)
+    snap = w.sample()
+    assert not w.device_stats_degraded
+    assert snap["device_bytes"] == 1100 * MB
+    assert snap["device_peak_bytes"] == 900 * MB
+    assert snap["hbm_drift"] == {"train_step": 0.9}
+    text = reg.render()
+    assert 'mem_device_peak_bytes{device="tpu:0"}' in text
+    assert 'mem_hbm_predict_vs_measured{program="train_step"} 0.9' in text
+    assert "zero_is_ignored" not in text
+
+
+def test_headroom_check(monkeypatch):
+    from jumbo_mae_tpu_tpu.obs import memwatch
+
+    w = MemoryWatcher(registry=MetricsRegistry())
+    monkeypatch.setattr(memwatch, "host_available_bytes", lambda: 1000 * MB)
+    assert w.headroom_check(100 * MB) is None
+    refusal = w.headroom_check(950 * MB)
+    assert refusal is not None and "950 MiB" in refusal
+    # unknowable headroom is not a refusal
+    monkeypatch.setattr(memwatch, "host_available_bytes", lambda: None)
+    assert w.headroom_check(10**15) is None
+
+
+# ----------------------------------------------------------- leak sentinel
+
+
+def _snaps(rss_series, components=None, t0=1000.0):
+    for i, rss in enumerate(rss_series):
+        snap = {"ts": t0 + 10.0 * i, "rss_bytes": int(rss)}
+        if components:
+            snap["components"] = {
+                name: int(series[i]) for name, series in components.items()
+            }
+        yield snap
+
+
+def test_sentinel_fires_once_names_component_and_latches():
+    reg = MetricsRegistry()
+    s = LeakSentinel(window=8, min_samples=4, min_growth_mb=32.0, registry=reg)
+    rss = [1000 * MB + i * 8 * MB for i in range(8)]
+    comps = {
+        "cache": [i * 7 * MB for i in range(8)],
+        "steady": [64 * MB] * 8,
+    }
+    fired = [s.observe(snap) for snap in _snaps(rss, comps)]
+    hits = [f for f in fired if f is not None]
+    assert len(hits) == 1
+    v = hits[0]
+    assert v["component"] == "cache"
+    assert v["robust_growth_bytes"] >= 32 * MB
+    assert v["window_span_s"] == pytest.approx(10.0 * (v["window"] - 1))
+    assert s.degraded() and s.suspect["component"] == "cache"
+    assert 'mem_leak_suspect{component="cache"} 1' in reg.render()
+    # latched: further growth does not re-fire
+    assert s.observe({"ts": 2000.0, "rss_bytes": 5000 * MB}) is None
+
+
+def test_sentinel_quiet_on_flat_rss_with_spike():
+    s = LeakSentinel(window=8, min_samples=4, min_growth_mb=32.0,
+                     registry=MetricsRegistry())
+    rss = [1000 * MB] * 8
+    rss[4] = 1400 * MB  # one eval window's temp buffer
+    assert all(s.observe(snap) is None for snap in _snaps(rss))
+    assert not s.degraded()
+
+
+def test_sentinel_demotes_minor_component_to_unaccounted():
+    """A mildly warming cache (<20% of the RSS slope) must not eat the
+    verdict for a native leak outside the accountant's reach."""
+    s = LeakSentinel(window=6, min_samples=4, min_growth_mb=32.0,
+                     registry=MetricsRegistry())
+    rss = [1000 * MB + i * 20 * MB for i in range(6)]
+    comps = {"cache": [i * MB for i in range(6)]}  # 1 MB/sample vs 20
+    hits = [f for f in _map_observe(s, rss, comps) if f]
+    assert len(hits) == 1 and hits[0]["component"] == "unaccounted"
+
+
+def _map_observe(s, rss, comps):
+    return [s.observe(snap) for snap in _snaps(rss, comps)]
+
+
+def test_sentinel_rejects_degenerate_window():
+    with pytest.raises(ValueError):
+        LeakSentinel(window=1, registry=MetricsRegistry())
+
+
+# --------------------------------------------------------- host.leak site
+
+
+def test_host_leak_fault_grows_and_clears_ballast():
+    try:
+        install_plan("host.leak:corrupt(2)")
+        assert host_leak_tick(key="0") == 2 * MB
+        assert host_leak_tick(key="1") == 4 * MB
+        assert leak_ballast_bytes() == 4 * MB
+        # a `raise` action means "the leak got fixed": ballast clears
+        install_plan("host.leak:raise(RuntimeError)")
+        assert host_leak_tick(key="2") == 0
+        # deactivation heals too
+        install_plan("host.leak:corrupt(2)")
+        host_leak_tick(key="3")
+        install_plan(None)
+        assert leak_ballast_bytes() == 0
+    finally:
+        clear_plan()
+
+
+def test_sentinel_catches_injected_host_leak():
+    """End-to-end on the library layer: the chaos site leaks, the
+    accountant attributes it, the sentinel names ``fault_ballast``."""
+    reg = MetricsRegistry()
+    acc = MemAccountant(registry=reg)
+    acc.register("fault_ballast", leak_ballast_bytes)
+    s = LeakSentinel(window=8, min_samples=4, min_growth_mb=32.0,
+                     registry=reg)
+    try:
+        install_plan("host.leak:corrupt(8)")
+        base = 2000 * MB
+        hit = None
+        for i in range(8):
+            ballast = host_leak_tick(key=str(i))
+            snap = {
+                "ts": 100.0 + i,
+                "rss_bytes": base + ballast,  # RSS tracks the ballast
+                "components": acc.sample(),
+            }
+            hit = s.observe(snap) or hit
+        assert hit is not None and hit["component"] == "fault_ballast"
+    finally:
+        clear_plan()
+
+
+# -------------------------------------------------------------- mem_doctor
+
+
+def _doctor_run_dir(tmp_path, *, leak: bool, with_device: bool = True):
+    with RunJournal(tmp_path / "journal", host=0) as j:
+        j.event("run_start", config={}, env={}, start_step=0)
+        for i in range(6):
+            fields = {
+                "step": 5 * (i + 1),
+                "rss_bytes": 1000 * MB + (i * 64 * MB if leak else 0),
+                "py_alloc_blocks": 100000 + i,
+                "components": {
+                    "fault_ballast": i * 60 * MB if leak else 0,
+                    "journal_file": 4096,
+                },
+            }
+            if with_device:
+                fields.update(
+                    device_bytes=700 * MB,
+                    device_peak_bytes=800 * MB,
+                    hbm_drift={"train_step": 0.8},
+                    hbm_capacity_bytes=8192 * MB,
+                )
+            j.event("mem_sample", **fields)
+        if leak:
+            j.event(
+                "mem_leak_suspect",
+                step=30,
+                component="fault_ballast",
+                rss_growth_bytes=320 * MB,
+                robust_growth_bytes=320 * MB,
+                slope_bytes_per_sample=64 * MB,
+                component_slope_bytes_per_sample=60 * MB,
+                window=6,
+                window_span_s=50.0,
+            )
+        j.event("shutdown", reason="completed", step=30)
+    return tmp_path
+
+
+class TestMemDoctor:
+    def test_leak_incident_exits_two_and_names_component(self, tmp_path, capsys):
+        import tools.mem_doctor as doctor
+
+        run_dir = _doctor_run_dir(tmp_path, leak=True)
+        assert doctor.main([str(run_dir)]) == 2
+        report = capsys.readouterr().out
+        assert "leak suspected: **fault_ballast**" in report
+        assert "| fault_ballast |" in report  # attribution table row
+        assert "OOM risk **low**" in report  # 800 MiB of 8 GiB
+        assert "| train_step | 0.8 |" in report
+
+    def test_healthy_run_exits_zero(self, tmp_path, capsys):
+        import tools.mem_doctor as doctor
+
+        run_dir = _doctor_run_dir(tmp_path, leak=False)
+        assert doctor.main([str(run_dir), "--out", str(tmp_path / "m.md")]) == 0
+        report = (tmp_path / "m.md").read_text()
+        assert "no leak suspected" in report
+        assert "OOM risk **low**" in report
+
+    def test_cpu_run_skips_oom_math(self, tmp_path, capsys):
+        import tools.mem_doctor as doctor
+
+        run_dir = _doctor_run_dir(tmp_path, leak=False, with_device=False)
+        assert doctor.main([str(run_dir)]) == 0
+        report = capsys.readouterr().out
+        assert "OOM risk not assessable" in report
+        assert "no drift ratios" in report
+
+    def test_nothing_to_diagnose_exits_two(self, tmp_path, capsys):
+        import tools.mem_doctor as doctor
+
+        assert doctor.main([str(tmp_path)]) == 2  # no journal at all
+        with RunJournal(tmp_path / "journal", host=0) as j:
+            j.event("run_start", config={}, env={}, start_step=0)
+        assert doctor.main([str(tmp_path)]) == 2  # journal, no mem samples
+        assert "no mem_sample rows" in capsys.readouterr().err

@@ -770,3 +770,34 @@ def test_real_engine_hot_swap_good_promotes_corrupt_rolls_back(tmp_path):
         assert rs.submit(_real_images(1)[0]).result(timeout=60) is not None
     finally:
         rs.close()
+
+
+def test_swap_headroom_rejection():
+    """A push whose double-buffer footprint (new tree + rollback snapshot)
+    doesn't fit host memory is rejected up front — before any parity probe,
+    canary pick, or weight flip — and a broken probe never blocks a swap."""
+    calls = []
+
+    def tight(need):
+        calls.append(need)
+        return "needs 512 KiB but only 1 KiB of host memory is safely available"
+
+    params = {"w": np.zeros((256, 256), np.float32)}
+    rs, ctl, reg = _swap_rig(
+        restore_fn=lambda p: (params, None), headroom_fn=tight
+    )
+    try:
+        rep = ctl.swap("/push/v1")
+        assert rep["verdict"] == "rejected" and rep["stage"] == "headroom"
+        assert "512 KiB" in rep["error"]
+        assert calls == [2 * 256 * 256 * 4]  # double-buffered tree bytes
+        assert [rs.replica(i).engine.version for i in range(3)] == ["v0"] * 3
+        assert _counter(reg, "serve_swap_rejected_total") == 1
+        # a probe that raises must not veto the swap
+        rs2, ctl2, _ = _swap_rig(headroom_fn=lambda need: 1 / 0)
+        try:
+            assert ctl2.swap("/push/v1")["verdict"] == "promoted"
+        finally:
+            rs2.close()
+    finally:
+        rs.close()

@@ -297,9 +297,11 @@ def test_batcher_stats_snapshot(tmp_path):
         release.set()
     s = mb.stats()
     assert s["queue_depth"] == 0
+    assert s["queue_bytes"] == 0
     assert set(s) == {
-        "queue_depth", "batch_occupancy", "mean_batch_occupancy",
-        "requests_submitted", "requests_shed", "shed_rate",
+        "queue_depth", "queue_bytes", "batch_occupancy",
+        "mean_batch_occupancy", "requests_submitted", "requests_shed",
+        "shed_rate",
     }
     log.close()
 

@@ -261,3 +261,39 @@ def test_corrupt_cache_entry_degrades_to_compile(tmp_path):
     assert b.warmcache.stats()["quarantined"] == 1
     out = b.features(_images(2, seed=31))
     assert np.isfinite(out).all()
+
+
+def test_main_dir_bounded_by_cache_max_bytes(tmp_path):
+    """``cache_max_bytes`` is LRU by mtime over the main dir: the oldest
+    entry AND its metadata sidecar go first, the disk gauge/stats track."""
+    import os
+    import time
+
+    from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+
+    # measure one entry+sidecar footprint against an unbounded cache
+    probe = WarmCache(tmp_path / "probe", registry=MetricsRegistry())
+    n1, n2 = "t-b1-f32-none.exe", "t-b2-f32-none.exe"
+    assert probe.put(n1, _tiny_executable())
+    entry_bytes = probe.disk_bytes()
+    assert entry_bytes > 0
+
+    reg = MetricsRegistry()
+    cap = int(entry_bytes * 1.5)  # room for one resident entry, not two
+    main = tmp_path / "main"
+    wc = WarmCache(main, registry=reg, cache_max_bytes=cap)
+    assert wc.put(n1, _tiny_executable())
+    # backdate the first entry so LRU-by-mtime picks it deterministically
+    old = time.time() - 3600
+    for p in (main / n1, main / f"{n1}.meta.json"):
+        os.utime(p, (old, old))
+    assert wc.put(n2, _tiny_executable())
+
+    assert sorted(p.name for p in main.iterdir()) == [n2, f"{n2}.meta.json"]
+    st = wc.stats()
+    assert st["main_pruned"] == 1 and st["entries"] == 1
+    assert 0 < st["disk_bytes"] <= cap
+    assert wc.get(n1) is None and wc.get(n2) is not None  # survivor serves
+    assert reg.gauge("infer_warmcache_disk_bytes", "x").value == st["disk_bytes"]
+    assert reg.counter("infer_warmcache_events_total", "x", labels=("event",)
+                       ).labels("pruned").value == 1

@@ -56,12 +56,18 @@ def _fleet_dir(path: Path) -> Path | None:
     return None
 
 
+def _mib(v) -> str:
+    return f"{float(v) / (1024 * 1024):.0f} MiB"
+
+
 def analyze(
     beacons: dict[int, dict],
     *,
     lag_steps: int = 2,
     ratio: float = 1.5,
     dead_after_s: float = 60.0,
+    mem_ratio: float = 1.5,
+    mem_floor_bytes: int = 256 * 1024 * 1024,
 ) -> dict:
     """Post-mortem status machine over a beacon snapshot.
 
@@ -94,6 +100,13 @@ def analyze(
         if b.get("data_wait_fraction") is not None
     )
     median_wait = waits[(len(waits) - 1) // 2] if waits else 0.0
+    # optional memwatch beacon fields (older beacons simply lack them)
+    rsses = sorted(
+        float(b["rss_bytes"])
+        for b in alive.values()
+        if b.get("rss_bytes") is not None
+    )
+    median_rss = rsses[(len(rsses) - 1) // 2] if rsses else 0.0
 
     hosts: dict[int, dict] = {}
     for h, b in sorted(beacons.items()):
@@ -130,6 +143,15 @@ def analyze(
             symptom = "step_time"
         else:
             symptom = "step_lag"
+        rss = b.get("rss_bytes")
+        mem_outlier = (
+            not lost
+            and len(alive) >= 2
+            and rss is not None
+            and median_rss > 0
+            and float(rss) >= mem_ratio * median_rss
+            and float(rss) - median_rss >= mem_floor_bytes
+        )
         hosts[h] = {
             "status": "lost" if lost else "straggler" if straggler else "ok",
             "step": step,
@@ -140,6 +162,13 @@ def analyze(
             "shard_retries": int(b.get("shard_retries", 0) or 0),
             "shard_quarantines": int(b.get("shard_quarantines", 0) or 0),
             "sentinel_bad_steps": int(b.get("sentinel_bad_steps", 0) or 0),
+            "rss_bytes": None if rss is None else int(rss),
+            "device_peak_bytes": (
+                None
+                if b.get("device_peak_bytes") is None
+                else int(b["device_peak_bytes"])
+            ),
+            "mem_outlier": bool(mem_outlier),
             "symptom": symptom,
             "hostname": b.get("hostname"),
             "pid": b.get("pid"),
@@ -149,6 +178,7 @@ def analyze(
         "max_step": max_step,
         "median_step_s": median_ema,
         "median_wait": median_wait,
+        "median_rss_bytes": median_rss,
     }
 
 
@@ -176,6 +206,8 @@ def diagnose(beacons: dict[int, dict], events: list[dict], args) -> str:
         lag_steps=args.lag_steps,
         ratio=args.ratio,
         dead_after_s=args.dead_after_s,
+        mem_ratio=args.mem_ratio,
+        mem_floor_bytes=int(args.mem_floor_mb * 1024 * 1024),
     )
     hosts = res["hosts"]
     stragglers = [e for e in events if e.get("type") == "fleet_straggler"]
@@ -230,6 +262,16 @@ def diagnose(beacons: dict[int, dict], events: list[dict], args) -> str:
             f"- straggler: **host {h}** — {sym} "
             f"({n} journaled straggler event(s); healthy in its final beacon)"
         )
+    # memory outliers are a flag, not a status: a leaking host still makes
+    # lockstep progress, so it's named alongside — not instead of — the
+    # straggler/lost verdicts
+    for h, s in sorted(hosts.items()):
+        if s.get("mem_outlier"):
+            lines.append(
+                f"- memory outlier: **host {h}** — rss {_mib(s['rss_bytes'])} "
+                f"vs fleet median {_mib(res['median_rss_bytes'])} "
+                f"(>= {args.mem_ratio:g}x + {args.mem_floor_mb:g} MiB floor)"
+            )
     lines.append("")
 
     # ------------------------------------------------------ per-host table
@@ -237,16 +279,23 @@ def diagnose(beacons: dict[int, dict], events: list[dict], args) -> str:
         "## Per-host health",
         "",
         "| host | status | step | lag | step-time EMA | data-wait | "
-        "retries | quarantines | bad steps | heartbeat age |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "retries | quarantines | bad steps | rss | heartbeat age |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for h, s in sorted(hosts.items()):
+        rss_cell = (
+            "—"
+            if s["rss_bytes"] is None
+            else _mib(s["rss_bytes"])
+            + (" ⚠ outlier" if s.get("mem_outlier") else "")
+        )
         lines.append(
             f"| {h} | {s['status']} | {s['step']} | {s['lag']} | "
             f"{_fmt_num(s['step_time_ema_s']) if s['step_time_ema_s'] is not None else '—'} | "
             f"{_fmt_num(s['data_wait_fraction']) if s['data_wait_fraction'] is not None else '—'} | "
             f"{s['shard_retries']} | {s['shard_quarantines']} | "
-            f"{s['sentinel_bad_steps']} | {_fmt_num(s['heartbeat_age_s'])}s |"
+            f"{s['sentinel_bad_steps']} | {rss_cell} | "
+            f"{_fmt_num(s['heartbeat_age_s'])}s |"
         )
     lines.append("")
 
@@ -306,6 +355,19 @@ def main(argv: list[str] | None = None) -> int:
         default=60.0,
         help="lost threshold: heartbeat seconds behind fleet-latest "
         "(default 60)",
+    )
+    parser.add_argument(
+        "--mem-ratio",
+        type=float,
+        default=1.5,
+        help="memory-outlier threshold: host rss / fleet median (default 1.5)",
+    )
+    parser.add_argument(
+        "--mem-floor-mb",
+        type=float,
+        default=256.0,
+        help="memory-outlier absolute floor: MiB above the fleet median "
+        "before the ratio counts (default 256)",
     )
     parser.add_argument(
         "--out", default=None, help="write the markdown here (default stdout)"
