@@ -101,6 +101,32 @@ def build_parser() -> argparse.ArgumentParser:
         "once (steady offered load for chaos and canary runs)",
     )
     p.add_argument(
+        "--tenants",
+        default="",
+        metavar="SPEC",
+        help="--replicas: traffic shaping — weighted multi-tenant admission "
+        "plus continuous batching. Comma-separated "
+        "name=class[:rate=N][:burst=N] entries (classes: "
+        "interactive|batch|scavenger); requests round-robin across tenants, "
+        "low classes shed first under pressure, and the continuous "
+        "scheduler coalesces late arrivals into pending batches",
+    )
+    p.add_argument(
+        "--autoscale",
+        default="",
+        metavar="MIN:MAX",
+        help="--replicas: reconcile the replica count between MIN and MAX "
+        "from SLO burn rate, queue depth, and roofline capacity; "
+        "scale-down drains the replica first (in-flight work is never "
+        "killed) and every resize journals an autoscale event",
+    )
+    p.add_argument(
+        "--autoscale-interval-s",
+        type=float,
+        default=1.0,
+        help="--autoscale reconcile tick seconds",
+    )
+    p.add_argument(
         "--swap-watch",
         default="",
         metavar="DIR",
@@ -278,6 +304,8 @@ def main(argv: list[str] | None = None) -> Path | None:
         health.probe("memory", memwatch.last_sample)
 
     replicated = bool(args.serve and args.replicas > 0)
+    if (args.tenants or args.autoscale) and not replicated:
+        raise SystemExit("--tenants/--autoscale require --serve --replicas N")
     # restarts and promoted swaps read the checkpoint through this cell,
     # so a replica rebuilt after a promote comes up on the new weights
     ckpt_ref = {"ckpt": args.ckpt}
@@ -534,6 +562,80 @@ def main(argv: list[str] | None = None) -> Path | None:
             slo_tracker.add_probe(
                 "healthy_replicas", lambda: rs.stats()["healthy"]
             )
+            slo_tracker.add_probe(
+                "batch_occupancy", lambda: rs.stats()["batch_occupancy"]
+            )
+        # traffic shaping (jumbo_mae_tpu_tpu/serve): tenant-weighted
+        # admission + continuous batching in front of the pool
+        sched = None
+        admission = None
+        tenant_names: list[str] = []
+        if args.tenants:
+            from jumbo_mae_tpu_tpu.serve import (
+                AdmissionController,
+                ContinuousScheduler,
+                parse_tenants,
+            )
+
+            tenant_specs = parse_tenants(args.tenants)
+            tenant_names = [t.name for t in tenant_specs]
+            admission = AdmissionController(tenant_specs)
+            # the scheduler's accumulator becomes the admission-visible
+            # queue; give the pool headroom above it so a dispatched group
+            # doesn't race the pool's own hard cap and shed an
+            # already-admitted interactive request
+            if rs.max_queue is not None:
+                rs.max_queue = rs.max_queue + 2 * args.max_batch
+            sched = ContinuousScheduler(
+                rs.submit_group,
+                max_batch=args.max_batch,
+                max_delay_ms=args.max_delay_ms,
+                max_queue=args.max_queue,
+                admission=admission,
+                tracer=tracer,
+                task=args.task,
+            )
+            # combined pressure: scheduler accumulator OR pool backlog —
+            # either filling sheds low classes before interactive traffic
+            # hits a hard queue-full
+            admission.set_pressure_fn(
+                lambda: max(sched.pressure(), rs.pressure())
+            )
+            print(
+                "[predict] traffic shaping: "
+                + ", ".join(f"{t.name}={t.tclass}" for t in tenant_specs)
+            )
+        autoscaler = None
+        if args.autoscale:
+            from jumbo_mae_tpu_tpu.serve import Autoscaler, roofline_capacity
+
+            try:
+                lo, hi = (int(x) for x in args.autoscale.split(":"))
+            except ValueError:
+                raise SystemExit("--autoscale expects MIN:MAX, e.g. 2:6")
+            # roofline capacity estimate for the serving bucket: forward
+            # FLOPs per image + the coarse activation-traffic bytes model
+            capacity_fn = None
+            enc_cfg = getattr(engine, "_enc", None)
+            if enc_cfg is not None:
+                from jumbo_mae_tpu_tpu.obs.mfu import encoder_flops_per_image
+
+                flops = encoder_flops_per_image(enc_cfg, masked=False)
+                act_bytes = 2.0 * flops / max(enc_cfg.dim, 1)
+                capacity_fn = lambda: roofline_capacity(flops, act_bytes)  # noqa: E731
+            autoscaler = Autoscaler(
+                rs,
+                min_replicas=lo,
+                max_replicas=hi,
+                interval_s=args.autoscale_interval_s,
+                slo=slo_tracker,
+                capacity_fn=capacity_fn,
+                tracer=tracer,
+            )
+            print(
+                f"[predict] autoscaler: [{lo}, {hi}] replicas, "
+                f"tick {args.autoscale_interval_s:g}s"
+            )
         swap_stop = threading.Event()
         swap_thread = None
         if swap_ctl is not None:
@@ -574,12 +676,30 @@ def main(argv: list[str] | None = None) -> Path | None:
                 f"every {args.swap_poll_s:g}s"
             )
         futs = []
-        for img in images:
-            futs.append(rs.submit(img, deadline_ms=args.deadline_ms))
+        shed = 0
+        for i, img in enumerate(images):
+            try:
+                if sched is not None:
+                    futs.append(
+                        sched.submit(
+                            img,
+                            deadline_ms=args.deadline_ms,
+                            tenant=tenant_names[i % len(tenant_names)],
+                        )
+                    )
+                else:
+                    futs.append(rs.submit(img, deadline_ms=args.deadline_ms))
+            except Exception as e:  # noqa: BLE001 — admission sheds are tallied, not fatal
+                shed += 1
+                futs.append(None)
+                print(f"[predict] request shed: {type(e).__name__}: {e}")
             if args.interarrival_ms > 0:
                 _time.sleep(args.interarrival_ms / 1000.0)
-        rows, failed = [], 0
+        rows, failed = [], shed
         for f in futs:
+            if f is None:
+                rows.append(None)
+                continue
             try:
                 rows.append(f.result())
             except Exception as e:  # noqa: BLE001 — typed failures are tallied, not fatal
@@ -593,6 +713,13 @@ def main(argv: list[str] | None = None) -> Path | None:
         if swap_thread is not None:
             swap_stop.set()
             swap_thread.join(timeout=args.swap_canary_timeout_s + 60.0)
+        if autoscaler is not None:
+            autoscaler.close()
+            print(f"[predict] autoscale events: {len(autoscaler.events)}")
+        if sched is not None:
+            sched.close()
+            if admission is not None:
+                print(f"[predict] admission: {json.dumps(admission.stats())}")
         st = rs.stats()
         print(f"[predict] replicas: {json.dumps(st['replicas'])}")
         rs.close()

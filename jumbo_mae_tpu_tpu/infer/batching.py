@@ -49,6 +49,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable
 
@@ -64,6 +65,56 @@ _STOP = object()
 class QueueFullError(RuntimeError):
     """Raised by ``submit()`` when the request queue is at ``max_queue`` —
     the caller should shed/retry elsewhere, not wait."""
+
+
+class OccupancyWindow:
+    """Windowed + exponentially-weighted batch-occupancy estimate.
+
+    ``stats()["batch_occupancy"]`` used to report only the *last* flush: one
+    straggler batch of 1 after a train of full batches read as near-zero
+    occupancy, and that single-batch jitter fed straight into the
+    ``slo_batch_occupancy`` probe and any autoscaler keyed on it. This keeps
+    an EWMA (``alpha`` per flush) plus a bounded window of recent flush
+    sizes, so snapshots reflect the recent *regime*, not the last batch.
+    Shared by :class:`MicroBatcher`, the replica pool, and the continuous
+    scheduler (``serve/scheduler.py``).
+    """
+
+    def __init__(self, max_batch: int, *, alpha: float = 0.2, window: int = 64):
+        self.max_batch = max(int(max_batch), 1)
+        self.alpha = float(alpha)
+        self._recent: deque = deque(maxlen=int(window))
+        self._ewma: float | None = None
+        self._last = 0
+        self._n = 0
+        self._lock = lockwatch.lock("batcher.occupancy")
+
+    def observe(self, size: int) -> None:
+        occ = min(size / self.max_batch, 1.0)
+        with self._lock:
+            self._recent.append(occ)
+            self._ewma = (
+                occ
+                if self._ewma is None
+                else self.alpha * occ + (1.0 - self.alpha) * self._ewma
+            )
+            self._last = size
+            self._n += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            recent = list(self._recent)
+            ewma = self._ewma
+            last = self._last
+            n = self._n
+        return {
+            "ewma": round(ewma, 4) if ewma is not None else 0.0,
+            "window_mean": (
+                round(sum(recent) / len(recent), 4) if recent else 0.0
+            ),
+            "last": round(last / self.max_batch, 4),
+            "batches": n,
+        }
 
 
 class DeadlineExceededError(TimeoutError):
@@ -115,6 +166,7 @@ class MicroBatcher:
         # coalesced batch without smuggling state through globals
         self.pass_meta = bool(pass_meta)
         self.batch_sizes: list[int] = []
+        self._occ = OccupancyWindow(self.max_batch)
         self._tracer = tracer  # obs.reqtrace.RequestTracer | None
         self.task = task
         # serving telemetry (obs/metrics.py): submit→result latency is THE
@@ -254,12 +306,16 @@ class MicroBatcher:
             submitted = self._submitted
             shed = self._shed_n
         sizes = self.batch_sizes
-        last = sizes[-1] if sizes else 0
         mean = sum(sizes) / len(sizes) if sizes else 0.0
+        occ = self._occ.snapshot()
         return {
             "queue_depth": depth,
             "queue_bytes": max(depth_bytes, 0),
-            "batch_occupancy": round(last / self.max_batch, 4),
+            # EWMA over recent flushes — NOT the last flush alone, which fed
+            # single-batch jitter into slo_batch_occupancy and the autoscaler
+            "batch_occupancy": occ["ewma"],
+            "last_batch_occupancy": occ["last"],
+            "window_batch_occupancy": occ["window_mean"],
             "mean_batch_occupancy": round(mean / self.max_batch, 4),
             "requests_submitted": submitted,
             "requests_shed": shed,
@@ -373,6 +429,7 @@ class MicroBatcher:
 
     def _flush(self, batch):
         self.batch_sizes.append(len(batch))
+        self._occ.observe(len(batch))
         self._m_batches.inc()
         self._m_requests.inc(len(batch))
         self._m_occupancy.observe(len(batch) / self.max_batch)

@@ -69,10 +69,15 @@ from jumbo_mae_tpu_tpu.faults.inject import fault_point
 from jumbo_mae_tpu_tpu.obs import lockwatch
 from jumbo_mae_tpu_tpu.infer.batching import (
     DeadlineExceededError,
+    OccupancyWindow,
     QueueFullError,
     ShutdownError,
 )
-from jumbo_mae_tpu_tpu.obs.metrics import NULL_REGISTRY, get_registry
+from jumbo_mae_tpu_tpu.obs.metrics import (
+    NULL_REGISTRY,
+    RATIO_BUCKETS,
+    get_registry,
+)
 
 _STOP = object()
 
@@ -199,7 +204,11 @@ class ReplicaSet:
         self.restart_backoff_s = float(restart_backoff_s)
         self.restart_backoff_max_s = float(restart_backoff_max_s)
         # default quorum: majority — the smallest pool that can still
-        # claim it is "the" serving tier rather than a stray survivor
+        # claim it is "the" serving tier rather than a stray survivor.
+        # An explicit quorum is pinned; the default majority is recomputed
+        # when scale_to() resizes the pool (a 4-replica quorum of 3 would
+        # latch the breaker open forever on a pool scaled down to 2).
+        self._explicit_quorum = quorum is not None
         self.quorum = quorum if quorum is not None else self.n // 2 + 1
         self._interval = float(supervise_interval_s)
         self._tracer = tracer
@@ -279,6 +288,12 @@ class ReplicaSet:
             "infer_requests_aborted_total",
             "pending requests failed by close()",
         )
+        self._m_occupancy = reg.histogram(
+            "infer_batch_occupancy",
+            "flushed batch size / max_batch",
+            buckets=RATIO_BUCKETS,
+        )
+        self._occ = OccupancyWindow(self.max_batch)
         self._m_quorum.set(self.quorum)
 
         self._depth = 0
@@ -292,6 +307,10 @@ class ReplicaSet:
         self._breaker_open = False
         self._canary_pref: str | None = None
         self._state_lock = lockwatch.lock("replicaset.state")
+        self._scale_lock = lockwatch.lock("replicaset.scale")
+        # slots removed by scale_to(): the supervisor keeps rescuing their
+        # queues so a submit that raced the removal is requeued, not lost
+        self._retired: list[_Replica] = []
 
         self._slots: list[_Replica] = []
         self._fails = [0] * self.n
@@ -320,13 +339,20 @@ class ReplicaSet:
         *,
         deadline_ms: float | None = None,
         meta=None,
+        tenant: str | None = None,
+        tclass: str | None = None,
     ) -> Future:
         """Route one request to a healthy replica; returns a future for
         its row of the batched result. Shed/deadline/shutdown semantics
         match :meth:`MicroBatcher.submit`; additionally raises
-        :class:`PoolUnhealthyError` when no replica is routable."""
+        :class:`PoolUnhealthyError` when no replica is routable.
+        ``tenant``/``tclass`` ride into the trace row (admission tier
+        attribution) — they do not change routing here."""
         tr = (
-            self._tracer.begin(task=self.task, deadline_ms=deadline_ms)
+            self._tracer.begin(
+                task=self.task, deadline_ms=deadline_ms,
+                tenant=tenant, tclass=tclass,
+            )
             if self._tracer is not None
             else None
         )
@@ -375,6 +401,74 @@ class ReplicaSet:
             self._live.add(rec)
         target.q.put(rec)
         return rec.fut
+
+    def submit_group(self, items) -> list[Future]:
+        """Route a pre-coalesced group of requests to ONE replica as a
+        unit — the continuous scheduler's dispatch path. ``items`` is a
+        list of ``(image, deadline, meta, tr)`` tuples where ``deadline``
+        is an absolute ``time.monotonic()`` instant (or ``None``) and
+        ``tr`` is a trace the *caller* already began (or ``None``). The
+        group lands consecutively on the least-loaded replica's queue, so
+        (for ``len(items) <= max_batch``) it flushes as one batch — the
+        occupancy the scheduler assembled is the occupancy the replica
+        runs.
+
+        Exception contract: on shed/shutdown/unroutable the group fails
+        as a unit — every trace in it is finished (``shed`` /
+        ``shutdown`` / ``aborted``) before the typed error is raised, and
+        the caller owns failing its own futures.
+        """
+        k = len(items)
+        if k == 0:
+            return []
+        traces = [it[3] for it in items if it[3] is not None]
+        try:
+            fault_point("serve.submit")
+            if self._closed:
+                raise ShutdownError("ReplicaSet is closed")
+            with self._depth_lock:
+                self._submitted += k
+                if (
+                    self.max_queue is not None
+                    and self._depth + k > self.max_queue
+                ):
+                    self._m_shed.inc(k)
+                    self._shed_n += k
+                    raise QueueFullError(
+                        f"request queue full "
+                        f"({self._depth}+{k}/{self.max_queue})"
+                    )
+                self._depth += k
+            target = self._pick(frozenset())
+            if target is None:
+                with self._depth_lock:
+                    self._depth -= k
+                raise PoolUnhealthyError(
+                    f"no healthy replica (healthy={self._healthy_count()}, "
+                    f"quorum={self.quorum})"
+                )
+        except BaseException as e:  # noqa: BLE001 — classify, trace, re-raise
+            if self._tracer is not None:
+                if isinstance(e, QueueFullError):
+                    outcome, err = "shed", None
+                elif isinstance(e, ShutdownError) or self._closed:
+                    outcome, err = "shutdown", None
+                else:
+                    outcome, err = "aborted", f"{type(e).__name__}: {e}"
+                for tr in traces:
+                    self._tracer.finish(tr, outcome, error=err)
+            raise
+        recs = []
+        for image, deadline, meta, tr in items:
+            fut: Future = Future()
+            if tr is not None:
+                fut.rid = tr.rid
+            recs.append(_Request(np.asarray(image), meta, deadline, fut, tr))
+        with self._live_lock:
+            self._live.update(recs)
+        for rec in recs:
+            target.q.put(rec)
+        return [rec.fut for rec in recs]
 
     def __call__(self, image, *, deadline_ms: float | None = None):
         return self.submit(image, deadline_ms=deadline_ms).result()
@@ -438,9 +532,147 @@ class ReplicaSet:
         survive a later replica restart)."""
         self._provider = fn
 
+    # ------------------------------------------------------------- scaling
+
+    def scale_to(self, n: int, *, drain_timeout_s: float = 10.0) -> dict:
+        """Resize the pool to ``n`` replicas, one slot at a time — the
+        autoscaler's actuator. Growth builds engines through the current
+        provider (warm cache → compile-free); shrink always removes the
+        *last* slot and only after pausing it and draining its queued and
+        in-flight work onto survivors' capacity (``wait_idle``), so
+        scale-down never kills an in-flight request. A slot that is down,
+        restarting, or won't drain within ``drain_timeout_s`` stops the
+        shrink for this round (the next reconcile retries). The default
+        majority quorum is recomputed per size; an explicit quorum is
+        pinned. Returns ``{"from", "to", "added", "removed", "stopped"}``.
+        """
+        if n < 1:
+            raise ValueError(f"scale target must be >= 1, got {n}")
+        with self._scale_lock:
+            start = len(self._slots)
+            added: list[str] = []
+            removed: list[str] = []
+            stopped: str | None = None
+            while len(self._slots) < n and not self._closed:
+                name = self._add_slot()
+                if name is None:
+                    stopped = "engine provider failed"
+                    break
+                added.append(name)
+            while len(self._slots) > n and not self._closed:
+                name = self._remove_last_slot(drain_timeout_s)
+                if name is None:
+                    stopped = "last slot not removable (down/restarting/undrained)"
+                    break
+                removed.append(name)
+            return {
+                "from": start,
+                "to": len(self._slots),
+                "added": added,
+                "removed": removed,
+                "stopped": stopped,
+            }
+
+    def _add_slot(self) -> str | None:
+        """Append one replica slot; returns its name, or ``None`` when the
+        engine provider failed (the pool is unchanged)."""
+        idx = len(self._slots)
+        try:
+            # engine construction OUTSIDE the state lock: a cold build can
+            # compile for seconds and serving must not stall behind it
+            engine = self._provider(idx)
+        except BaseException as e:  # noqa: BLE001 — a provider error is a failed scale step
+            self._event(
+                "replica_restart_failed", replica=f"r{idx}",
+                err=f"{type(e).__name__}: {e}",
+            )
+            return None
+        rep = _Replica(idx, gen=0, engine=engine)
+        with self._state_lock:
+            self._slots.append(rep)
+            self._fails.append(0)
+            self._restart_at.append(0.0)
+            self._restarting.append(False)
+            self.n = len(self._slots)
+            if not self._explicit_quorum:
+                self.quorum = self.n // 2 + 1
+                self._m_quorum.set(self.quorum)
+            self._update_health()
+        self._start_worker(rep)
+        self._m_up.labels(rep.name).set(1)
+        if self._health is not None:
+            self._health.beat(f"replica.{rep.name}")
+        self._event("replica_added", replica=rep.name, pool=self.n)
+        return rep.name
+
+    def _remove_last_slot(self, drain_timeout_s: float) -> str | None:
+        """Drain and retire the last slot; returns its name, or ``None``
+        when it cannot be removed right now (pool of one, slot down or
+        restarting, or the drain timed out — in which case routing is
+        restored)."""
+        with self._state_lock:
+            if len(self._slots) <= 1:
+                return None
+            rep = self._slots[-1]
+            if self._restarting[rep.idx] or rep.state == "down":
+                return None
+            we_paused = rep.state == "up"
+            if we_paused:
+                rep.state = "paused"  # out of routing; drains what it has
+        if not self.wait_idle(rep.idx, drain_timeout_s):
+            with self._state_lock:
+                if (
+                    we_paused
+                    and not self._stale(rep)
+                    and rep.state == "paused"
+                ):
+                    rep.state = "up"
+            return None
+        with self._state_lock:
+            # re-verify under the lock: a hang/crash during the drain
+            # (or a racing restart) means this incarnation no longer owns
+            # the slot — leave it to the supervisor
+            if (
+                self._stale(rep)
+                or rep.idx != len(self._slots) - 1
+                or rep.state != "paused"
+            ):
+                return None
+            self._slots.pop()
+            self._fails.pop()
+            self._restart_at.pop()
+            self._restarting.pop()
+            self.n = len(self._slots)
+            if not self._explicit_quorum:
+                self.quorum = self.n // 2 + 1
+                self._m_quorum.set(self.quorum)
+            self._update_health()
+            # the supervisor keeps rescuing this queue: a submit that
+            # picked the slot before the pop lands here after it
+            self._retired.append(rep)
+        rep.q.put(_STOP)
+        self._m_up.labels(rep.name).set(0)
+        self._event("replica_removed", replica=rep.name, gen=rep.gen, pool=self.n)
+        self._drain_slot(rep, "replica removed")
+        rep.engine = None  # drop the engine's memory with the slot
+        return rep.name
+
+    def pressure(self) -> float:
+        """Pending depth / max_queue in [0, ~] — cheap enough to call per
+        admission decision (one counter read, no slot snapshot). Unbounded
+        queue → always 0."""
+        if not self.max_queue:
+            return 0.0
+        with self._depth_lock:
+            return self._depth / self.max_queue
+
     def stats(self) -> dict:
         with self._depth_lock:
             depth, submitted, shed = self._depth, self._submitted, self._shed_n
+        occ = self._occ.snapshot()
+        with self._state_lock:
+            slots = list(self._slots)
+            fails = list(self._fails)
         return {
             "replicas": {
                 rep.name: {
@@ -448,9 +680,9 @@ class ReplicaSet:
                     "gen": rep.gen,
                     "queued": rep.q.qsize(),
                     "served": rep.served,
-                    "restarts": self._fails[rep.idx],
+                    "restarts": fails[i] if i < len(fails) else 0,
                 }
-                for rep in self._slots
+                for i, rep in enumerate(slots)
             },
             "healthy": self._healthy_count(),
             "quorum": self.quorum,
@@ -458,6 +690,10 @@ class ReplicaSet:
             "queue_depth": depth,
             "requests_submitted": submitted,
             "requests_shed": shed,
+            # EWMA/windowed flush occupancy (autoscaler + SLO probe input)
+            "batch_occupancy": occ["ewma"],
+            "window_batch_occupancy": occ["window_mean"],
+            "batches_flushed": occ["batches"],
         }
 
     def close(self, drain: bool = True, timeout_s: float = 10.0):
@@ -614,7 +850,9 @@ class ReplicaSet:
         rep.thread.start()
 
     def _stale(self, rep: _Replica) -> bool:
-        return self._slots[rep.idx] is not rep
+        # the idx bound matters post-scale_to(): a removed slot's worker
+        # (or zombie) must read as stale, not IndexError
+        return rep.idx >= len(self._slots) or self._slots[rep.idx] is not rep
 
     def _worker(self, rep: _Replica) -> None:
         while not self._stale(rep):
@@ -678,6 +916,8 @@ class ReplicaSet:
         crashed (worker must exit)."""
         self._m_batches.inc()
         self._m_requests.inc(len(batch))
+        self._m_occupancy.observe(len(batch) / self.max_batch)
+        self._occ.observe(len(batch))
         traces = [rec.tr for rec in batch if rec.tr is not None]
         if traces:
             self._tracer.flush_begin(traces)
@@ -754,7 +994,7 @@ class ReplicaSet:
 
     def _mark_down(self, rep: _Replica) -> None:
         with self._state_lock:
-            if self._slots[rep.idx] is not rep or rep.state == "down":
+            if self._stale(rep) or rep.state == "down":
                 return
             rep.state = "down"
             self._m_up.labels(rep.name).set(0)
@@ -787,6 +1027,10 @@ class ReplicaSet:
     def _supervise(self) -> None:
         while not self._closed:
             now = self._clock()
+            # retired slots keep getting rescued: a submit that raced a
+            # scale-down removal may still land on a retired queue
+            for rep in list(self._retired):
+                self._drain_slot(rep, "replica removed")
             for rep in list(self._slots):
                 if rep.state in ("up", "paused"):
                     busy = rep.busy_since

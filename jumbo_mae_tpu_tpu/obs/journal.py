@@ -77,6 +77,9 @@ JOURNAL_EVENTS = frozenset(
         "lock_order_violation",
         "mem_sample",
         "mem_leak_suspect",
+        "autoscale",
+        "replica_added",
+        "replica_removed",
     }
 )
 

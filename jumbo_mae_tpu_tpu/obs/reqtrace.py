@@ -58,13 +58,22 @@ class RequestTrace:
         "rid", "task", "deadline_ms", "wall_ts", "t0", "t_admit", "t_flush",
         "queue_wait_s", "admission_s", "compute_s", "fetch_s",
         "batch", "bucket", "pad_fraction", "latency_s", "outcome", "error",
-        "replica_id", "retries", "requeued_from",
+        "replica_id", "retries", "requeued_from", "tenant", "tclass",
     )
 
-    def __init__(self, rid: int, task: str, deadline_ms: float | None):
+    def __init__(
+        self,
+        rid: int,
+        task: str,
+        deadline_ms: float | None,
+        tenant: str | None = None,
+        tclass: str | None = None,
+    ):
         self.rid = rid
         self.task = task
         self.deadline_ms = deadline_ms
+        self.tenant = tenant
+        self.tclass = tclass
         self.wall_ts = time.time()
         self.t0 = time.perf_counter()
         self.t_admit = None
@@ -189,8 +198,15 @@ class RequestTracer:
 
     # ------------------------------------------------------------ lifecycle
 
-    def begin(self, *, task: str = "", deadline_ms: float | None = None) -> RequestTrace:
-        return RequestTrace(self._next_rid(), task, deadline_ms)
+    def begin(
+        self,
+        *,
+        task: str = "",
+        deadline_ms: float | None = None,
+        tenant: str | None = None,
+        tclass: str | None = None,
+    ) -> RequestTrace:
+        return RequestTrace(self._next_rid(), task, deadline_ms, tenant, tclass)
 
     def admitted(self, tr: RequestTrace) -> None:
         tr.t_admit = time.perf_counter()
@@ -259,6 +275,8 @@ class RequestTracer:
                 ("bucket", tr.bucket),
                 ("pad", tr.pad_fraction),
                 ("deadline_ms", tr.deadline_ms),
+                ("tenant", tr.tenant),
+                ("class", tr.tclass),
                 ("replica", tr.replica_id),
                 ("retries", tr.retries or None),
                 ("requeued_from", tr.requeued_from),

@@ -136,6 +136,7 @@ class SLOTracker:
         # requests cannot grow host memory without limit
         self._samples: deque = deque(maxlen=int(max_samples))
         self._last_breach_t: float | None = None
+        self.last_report: dict | None = None
         reg = registry if registry is not None else get_registry()
         self._g_value = reg.gauge(
             "slo_value", "current value of each SLO metric", labels=("objective",)
@@ -274,7 +275,15 @@ class SLOTracker:
                 self._g_probes[name].set(float(fn()))
             except Exception:  # noqa: BLE001 — a probe must not break evals
                 pass
+        self.last_report = report
         return report
+
+    def worst_burn(self, now: float | None = None) -> float:
+        """Fresh evaluation collapsed to the autoscaler's scalar input:
+        the worst slow-window burn rate across objectives (1.0 = budget
+        spent exactly as it accrues; >1 = too fast)."""
+        rep = self.evaluate(now)
+        return max((o["burn_slow"] for o in rep["objectives"]), default=0.0)
 
     def _degraded_at(self, now: float) -> bool:
         with self._lock:

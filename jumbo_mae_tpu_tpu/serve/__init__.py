@@ -1,0 +1,42 @@
+"""Traffic-shaping tier: the scheduling brain between request submission
+and the replicated serving pool.
+
+Three cooperating components (ROADMAP open item 2 — the gap between
+"survives crashes" and "serves millions of users"):
+
+- :mod:`~jumbo_mae_tpu_tpu.serve.admission` — per-tenant token-bucket
+  quotas and priority classes (``interactive`` > ``batch`` >
+  ``scavenger``): under pressure, low-priority tenants shed *first*.
+- :mod:`~jumbo_mae_tpu_tpu.serve.scheduler` — continuous batching:
+  per-(task, shape-bucket) accumulators admit late arrivals into
+  partially-filled pending batches up to a deadline-aware cutoff, and the
+  next batch is picked by occupancy + oldest-waiter age + priority class.
+- :mod:`~jumbo_mae_tpu_tpu.serve.autoscaler` — a reconcile loop turning
+  SLO burn rate, queue depth/occupancy, and roofline capacity estimates
+  (``obs/perfmodel``) into a target replica count, actuated through
+  :meth:`ReplicaSet.scale_to` (scale-down drains; never kills in-flight
+  work).
+"""
+
+from jumbo_mae_tpu_tpu.serve.admission import (
+    CLASSES,
+    AdmissionController,
+    TenantPressureError,
+    TenantQuotaError,
+    TenantSpec,
+    parse_tenants,
+)
+from jumbo_mae_tpu_tpu.serve.autoscaler import Autoscaler, roofline_capacity
+from jumbo_mae_tpu_tpu.serve.scheduler import ContinuousScheduler
+
+__all__ = [
+    "CLASSES",
+    "AdmissionController",
+    "Autoscaler",
+    "ContinuousScheduler",
+    "TenantPressureError",
+    "TenantQuotaError",
+    "TenantSpec",
+    "parse_tenants",
+    "roofline_capacity",
+]

@@ -1,0 +1,254 @@
+"""Weighted multi-tenant admission: token-bucket quotas + priority classes.
+
+The pool's existing shedding is blind: ``max_queue`` sheds whoever submits
+next, so one scraping tenant flooding the queue starves the interactive
+tenant behind it. Admission makes shedding *weighted*: every request names
+a tenant, every tenant has a priority class, and under pressure the low
+classes shed first —
+
+- ``interactive`` — latency-sensitive user traffic; sheds only at full
+  pressure (and jumps the dispatch queue in the continuous scheduler);
+- ``batch`` — throughput traffic; sheds when the pool is clearly loaded;
+- ``scavenger`` — best-effort backfill; sheds at the first sign of load.
+
+Two independent shed reasons, both subclasses of the pool's
+:class:`~jumbo_mae_tpu_tpu.infer.batching.QueueFullError` so existing
+callers' shed handling works unchanged:
+
+- **quota** (:class:`TenantQuotaError`): the tenant's own token bucket is
+  empty — it exceeded its contracted rate, regardless of pool load;
+- **pressure** (:class:`TenantPressureError`): the pool-wide pressure
+  signal (queue depth / max_queue, supplied by the scheduler) crossed the
+  class's shed threshold — the pool is protecting higher classes.
+
+Token buckets refill continuously at ``rate`` tokens/s up to ``burst``;
+a tenant with no rate is unmetered (class pressure still applies). The
+clock is injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from jumbo_mae_tpu_tpu.infer.batching import QueueFullError
+from jumbo_mae_tpu_tpu.obs import lockwatch
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+# priority order: index 0 sheds last, jumps the queue first
+CLASSES = ("interactive", "batch", "scavenger")
+
+# pool pressure (0..1) at which each class starts shedding: scavenger
+# gives way at half load, batch at heavy load, interactive only when the
+# queue is actually full (pressure >= 1.0 is the old max_queue shed)
+CLASS_SHED_PRESSURE = {"interactive": 1.0, "batch": 0.85, "scavenger": 0.5}
+
+# scheduler score bonus per class (scheduler.py): a waiting interactive
+# request outweighs an equally-old batch request
+CLASS_WEIGHT = {"interactive": 1.0, "batch": 0.35, "scavenger": 0.0}
+
+
+class TenantQuotaError(QueueFullError):
+    """The tenant's token bucket is empty — over its contracted rate."""
+
+
+class TenantPressureError(QueueFullError):
+    """Pool pressure crossed this tenant's class shed threshold."""
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's contract: priority class + optional rate limit."""
+
+    name: str
+    tclass: str = "batch"
+    rate: float | None = None     # tokens (requests) per second
+    burst: float | None = None    # bucket capacity; defaults to max(rate, 1)
+
+    def __post_init__(self):
+        if self.tclass not in CLASSES:
+            raise ValueError(
+                f"unknown tenant class {self.tclass!r} for {self.name!r}; "
+                f"expected one of {CLASSES}"
+            )
+        if self.rate is not None and self.rate <= 0:
+            raise ValueError(f"tenant {self.name!r} rate must be > 0")
+
+
+def parse_tenants(spec: str) -> list[TenantSpec]:
+    """Parse the ``--tenants`` flag:
+    ``"web=interactive:rate=50:burst=100,scrape=batch:rate=5"``.
+
+    Each comma-separated entry is ``name=class[:rate=N][:burst=N]``;
+    class must be one of :data:`CLASSES`. Typos fail loudly — a silent
+    default would quietly demote a tenant to ``batch``.
+    """
+    tenants: list[TenantSpec] = []
+    seen: set[str] = set()
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise ValueError(
+                f"bad tenant entry {entry!r}; expected name=class[:rate=N]"
+            )
+        name, _, rest = entry.partition("=")
+        name = name.strip()
+        if not name:
+            raise ValueError(f"empty tenant name in {entry!r}")
+        if name in seen:
+            raise ValueError(f"duplicate tenant {name!r}")
+        seen.add(name)
+        parts = rest.split(":")
+        tclass = parts[0].strip()
+        rate = burst = None
+        for opt in parts[1:]:
+            key, _, val = opt.partition("=")
+            key = key.strip()
+            if key == "rate":
+                rate = float(val)
+            elif key == "burst":
+                burst = float(val)
+            else:
+                raise ValueError(
+                    f"unknown tenant option {key!r} in {entry!r} "
+                    f"(rate, burst)"
+                )
+        tenants.append(TenantSpec(name, tclass, rate, burst))
+    if not tenants:
+        raise ValueError(f"empty tenant spec {spec!r}")
+    return tenants
+
+
+class _Bucket:
+    """One tenant's token bucket; caller holds the admission lock."""
+
+    __slots__ = ("rate", "capacity", "tokens", "t")
+
+    def __init__(self, rate: float, burst: float | None, now: float):
+        self.rate = float(rate)
+        self.capacity = float(burst) if burst is not None else max(rate, 1.0)
+        self.tokens = self.capacity
+        self.t = now
+
+    def take(self, now: float) -> bool:
+        self.tokens = min(
+            self.capacity, self.tokens + (now - self.t) * self.rate
+        )
+        self.t = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
+class AdmissionController:
+    """Admit-or-shed gate in front of the scheduler.
+
+    ``pressure_fn`` is a zero-arg callable returning the pool's current
+    pressure in [0, 1] (the scheduler supplies pending-depth /
+    max_queue); without one, only quotas apply. Unknown tenants are
+    admitted with the default ``batch`` class and no quota — admission
+    shapes traffic, it is not an auth layer.
+    """
+
+    def __init__(
+        self,
+        tenants,
+        *,
+        pressure_fn=None,
+        registry=None,
+        clock=time.monotonic,
+    ):
+        self._clock = clock
+        self._pressure_fn = pressure_fn
+        self._specs = {t.name: t for t in tenants}
+        self._lock = lockwatch.lock("serve.admission")
+        now = clock()
+        self._buckets = {
+            t.name: _Bucket(t.rate, t.burst, now)
+            for t in tenants
+            if t.rate is not None
+        }
+        self._default = TenantSpec("_default", "batch")
+        reg = registry if registry is not None else get_registry()
+        self._m_admitted = reg.counter(
+            "serve_admit_total",
+            "requests admitted past the tenant gate",
+            labels=("tenant", "class"),
+        )
+        self._m_shed = reg.counter(
+            "serve_admit_shed_total",
+            "requests shed at admission by reason (quota|pressure)",
+            labels=("tenant", "class", "reason"),
+        )
+        self._m_pressure = reg.gauge(
+            "serve_admit_pressure",
+            "pool pressure sampled at the last admission decision",
+        )
+        # shed bookkeeping for stats()/tests, by (tenant, reason)
+        self._admitted_n: dict[str, int] = {}
+        self._shed_n: dict[tuple[str, str], int] = {}
+
+    def set_pressure_fn(self, fn) -> None:
+        """Late-bind the pool pressure probe — the scheduler that supplies
+        it usually takes this controller as a constructor argument."""
+        self._pressure_fn = fn
+
+    def spec(self, tenant: str | None) -> TenantSpec:
+        if tenant is None:
+            return self._default
+        return self._specs.get(tenant, TenantSpec(tenant, "batch"))
+
+    def pressure(self) -> float:
+        if self._pressure_fn is None:
+            return 0.0
+        try:
+            return max(0.0, float(self._pressure_fn()))
+        except Exception:  # noqa: BLE001 — a broken probe must not shed traffic
+            return 0.0
+
+    def admit(self, tenant: str | None) -> TenantSpec:
+        """Gate one request; returns the tenant's spec (class for the
+        trace row and the scheduler score) or raises a typed shed.
+
+        Pressure is checked before quota: under load, a low class sheds
+        even with tokens in the bank — the whole point is protecting the
+        higher classes' capacity.
+        """
+        sp = self.spec(tenant)
+        pressure = self.pressure()
+        self._m_pressure.set(pressure)
+        if pressure >= CLASS_SHED_PRESSURE[sp.tclass]:
+            self._shed(sp, "pressure")
+            raise TenantPressureError(
+                f"tenant {sp.name!r} ({sp.tclass}) shed at pressure "
+                f"{pressure:.2f} >= {CLASS_SHED_PRESSURE[sp.tclass]}"
+            )
+        bucket = self._buckets.get(sp.name)
+        if bucket is not None:
+            with self._lock:
+                ok = bucket.take(self._clock())
+            if not ok:
+                self._shed(sp, "quota")
+                raise TenantQuotaError(
+                    f"tenant {sp.name!r} over quota "
+                    f"({bucket.rate:g} req/s, burst {bucket.capacity:g})"
+                )
+        self._m_admitted.labels(sp.name, sp.tclass).inc()
+        with self._lock:
+            self._admitted_n[sp.name] = self._admitted_n.get(sp.name, 0) + 1
+        return sp
+
+    def _shed(self, sp: TenantSpec, reason: str) -> None:
+        self._m_shed.labels(sp.name, sp.tclass, reason).inc()
+        with self._lock:
+            key = (sp.name, reason)
+            self._shed_n[key] = self._shed_n.get(key, 0) + 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            admitted = dict(self._admitted_n)
+            shed = {f"{t}:{r}": n for (t, r), n in self._shed_n.items()}
+        return {"admitted": admitted, "shed": shed}

@@ -1,0 +1,479 @@
+"""Continuous batching: late arrivals join pending batches until cutoff.
+
+The per-replica :class:`MicroBatcher` loop is FIFO-per-flush: the first
+request opens a batch window, the window closes, the batch runs — and a
+request arriving 1 ms after the close waits out a whole new window. Worse,
+the pool router splits concurrent arrivals *across* replicas, so each
+replica flushes a half-empty batch and the pad fraction burns MXU cycles
+(`infer_batch_occupancy` tells the story).
+
+The :class:`ContinuousScheduler` centralizes coalescing: one dispatcher
+thread owns per-``(task, shape-bucket)`` accumulators; every arrival joins
+its bucket's *pending* batch — including one already waiting to dispatch —
+up to a deadline-aware cutoff. A batch becomes *ready* when it fills or
+its cutoff passes; among ready batches the dispatcher picks the highest
+
+    score = occupancy + oldest_wait / max_delay + max(class weight)
+
+so full batches go first, no waiter starves (age grows without bound),
+and interactive tenants outrank batch/scavenger at equal fill. Within the
+dispatched batch, slots go to the highest class first (the admit-queue
+jump): when a batch is over-full, the *low*-class overflow waits for the
+next one. Dispatch hands the whole group to
+:meth:`ReplicaSet.submit_group`, which lands it on ONE replica as one
+flush — the occupancy the scheduler assembled is the occupancy the
+replica runs.
+
+Exactly-once: after ``submit`` enqueues an entry, only the dispatcher
+thread touches it, and each entry leaves exactly one way — expired
+(deadline), failed (dispatch error / shutdown), or chained to the backend
+future that resolves it. The backend owns each trace once dispatch is
+called (`submit_group`'s contract); the scheduler finishes traces only
+for entries that never reached dispatch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from jumbo_mae_tpu_tpu.faults.inject import fault_point
+from jumbo_mae_tpu_tpu.infer.batching import (
+    DeadlineExceededError,
+    OccupancyWindow,
+    QueueFullError,
+    ShutdownError,
+)
+from jumbo_mae_tpu_tpu.obs import lockwatch
+from jumbo_mae_tpu_tpu.obs.metrics import RATIO_BUCKETS, get_registry
+from jumbo_mae_tpu_tpu.serve.admission import CLASSES, CLASS_WEIGHT
+
+_STOP = object()
+
+# a deadline-carrying entry must dispatch this fraction of max_delay
+# before the deadline itself, or compute time eats the remaining budget
+_DEADLINE_MARGIN = 0.25
+
+
+def floor_bucket(k: int, max_batch: int) -> int:
+    """Largest engine pad-bucket size <= k: the engine pads every flush up
+    to a power-of-2 bucket (capped at max_batch, itself the top rung), so
+    a batch of exactly this size runs with zero pad rows."""
+    if k >= max_batch:
+        return max_batch
+    b = 1
+    while b * 2 <= k:
+        b *= 2
+    return b
+
+
+class _Entry:
+    __slots__ = (
+        "image", "fut", "tr", "tenant", "tclass", "deadline",
+        "meta", "task", "t_submit",
+    )
+
+    def __init__(self, image, fut, tr, tenant, tclass, deadline, meta, task, now):
+        self.image = image
+        self.fut = fut
+        self.tr = tr
+        self.tenant = tenant
+        self.tclass = tclass
+        self.deadline = deadline   # absolute time.monotonic() instant | None
+        self.meta = meta
+        self.task = task
+        self.t_submit = now
+
+
+class ContinuousScheduler:
+    """Cross-request batch assembler in front of a dispatch backend.
+
+    ``dispatch(items)`` receives ``[(image, deadline, meta, tr), ...]``
+    and returns one backend future per item —
+    :meth:`ReplicaSet.submit_group` is the production backend; tests pass
+    a stub. ``admission`` is an optional
+    :class:`~jumbo_mae_tpu_tpu.serve.admission.AdmissionController`;
+    when the scheduler builds its own pressure signal
+    (pending / ``max_queue``), wire ``admission.pressure_fn`` to
+    :meth:`pressure`. ``clock`` must be ``time.monotonic``-like (absolute
+    deadlines are compared against it).
+    """
+
+    def __init__(
+        self,
+        dispatch,
+        *,
+        max_batch: int = 32,
+        max_delay_ms: float = 5.0,
+        max_queue: int | None = None,
+        admission=None,
+        tracer=None,
+        task: str = "",
+        registry=None,
+        clock=time.monotonic,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._dispatch = dispatch
+        self.max_batch = int(max_batch)
+        self.max_delay = float(max_delay_ms) / 1000.0
+        self.max_queue = max_queue
+        self.admission = admission
+        self._tracer = tracer
+        self.task = task
+        self._clock = clock
+        reg = registry if registry is not None else get_registry()
+        self._m_batches = reg.counter(
+            "serve_sched_batches_total",
+            "batches dispatched by the continuous scheduler, by trigger "
+            "(full|cutoff|aligned|close)",
+            labels=("reason",),
+        )
+        self._m_occupancy = reg.histogram(
+            "serve_sched_batch_occupancy",
+            "dispatched batch size / max_batch (continuous scheduler)",
+            buckets=RATIO_BUCKETS,
+        )
+        self._m_depth = reg.gauge(
+            "serve_sched_queue_depth",
+            "requests pending in scheduler accumulators",
+        )
+        self._m_jumps = reg.counter(
+            "serve_sched_priority_jumps_total",
+            "dispatch slots a higher class took ahead of an earlier-"
+            "arrived lower-class request",
+        )
+        self._occ = OccupancyWindow(self.max_batch)
+        self._depth = 0
+        self._depth_lock = lockwatch.lock("serve.sched.depth")
+        self._dispatched = 0
+        self._expired = 0
+        self._closed = False
+        self._drain = True
+        self._wake: queue.SimpleQueue = queue.SimpleQueue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="continuous-scheduler"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- client
+
+    def submit(
+        self,
+        image,
+        *,
+        task: str | None = None,
+        deadline_ms: float | None = None,
+        meta=None,
+        tenant: str | None = None,
+    ) -> Future:
+        """Admit one request into its (task, shape) accumulator; returns a
+        future. Sheds typed: tenant-weighted
+        (:class:`TenantQuotaError` / :class:`TenantPressureError`) when an
+        admission controller is attached, plus the hard
+        :class:`QueueFullError` backstop at ``max_queue``."""
+        sp = None
+        tclass = None
+        if self.admission is not None:
+            sp = self.admission.spec(tenant)
+            tclass = sp.tclass
+        tr = (
+            self._tracer.begin(
+                task=task if task is not None else self.task,
+                deadline_ms=deadline_ms,
+                tenant=tenant,
+                tclass=tclass,
+            )
+            if self._tracer is not None
+            else None
+        )
+        arr = np.asarray(image)
+        try:
+            fault_point("serve.submit")
+            if self._closed:
+                raise ShutdownError("ContinuousScheduler is closed")
+            if self.admission is not None:
+                self.admission.admit(tenant)
+            with self._depth_lock:
+                if self.max_queue is not None and self._depth >= self.max_queue:
+                    raise QueueFullError(
+                        f"scheduler queue full ({self._depth}/{self.max_queue})"
+                    )
+                self._depth += 1
+        except BaseException as e:  # noqa: BLE001 — classify, trace, re-raise
+            if tr is not None:
+                if isinstance(e, QueueFullError):
+                    self._tracer.finish(tr, "shed")
+                elif isinstance(e, ShutdownError) or self._closed:
+                    self._tracer.finish(tr, "shutdown")
+                else:
+                    self._tracer.finish(
+                        tr, "aborted", error=f"{type(e).__name__}: {e}"
+                    )
+            raise
+        fut: Future = Future()
+        if tr is not None:
+            fut.rid = tr.rid
+        now = self._clock()
+        deadline = (
+            None if deadline_ms is None else now + float(deadline_ms) / 1000.0
+        )
+        entry = _Entry(
+            arr, fut, tr, tenant, tclass, deadline, meta,
+            task if task is not None else self.task, now,
+        )
+        self._wake.put(entry)
+        return fut
+
+    def pressure(self) -> float:
+        """Pending depth / max_queue in [0, ~]: the admission
+        controller's pool-pressure signal. Unbounded queue → always 0."""
+        if not self.max_queue:
+            return 0.0
+        with self._depth_lock:
+            return self._depth / self.max_queue
+
+    def stats(self) -> dict:
+        with self._depth_lock:
+            depth = self._depth
+        occ = self._occ.snapshot()
+        return {
+            "queue_depth": depth,
+            "pressure": self.pressure(),
+            "dispatched": self._dispatched,
+            "expired": self._expired,
+            "batch_occupancy": occ["ewma"],
+            "window_batch_occupancy": occ["window_mean"],
+            "batches": occ["batches"],
+        }
+
+    def close(self, drain: bool = True, timeout_s: float = 10.0) -> None:
+        """Stop the dispatcher and resolve every undispatched entry:
+        ``drain=True`` fails them with :class:`ShutdownError`;
+        ``drain=False`` dispatches the leftovers first."""
+        if self._closed:
+            return
+        self._drain = drain
+        self._closed = True
+        self._wake.put(_STOP)
+        self._thread.join(timeout=timeout_s)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --------------------------------------------------------- dispatcher
+
+    def _cutoff(self, entry: _Entry) -> float:
+        cut = entry.t_submit + self.max_delay
+        if entry.deadline is not None:
+            cut = min(cut, entry.deadline - _DEADLINE_MARGIN * self.max_delay)
+        return cut
+
+    def _loop(self) -> None:
+        # all accumulator state lives on this thread — no locks
+        buckets: dict[tuple, list[_Entry]] = {}
+        while True:
+            timeout = self._next_wait(buckets)
+            try:
+                item = self._wake.get(timeout=timeout)
+            except queue.Empty:
+                item = None
+            if item is _STOP:
+                self._shutdown(buckets)
+                return
+            if item is not None:
+                key = (item.task, item.image.shape)
+                buckets.setdefault(key, []).append(item)
+                # opportunistic drain: pull everything already queued so a
+                # burst lands in its accumulators in one pass
+                while True:
+                    try:
+                        nxt = self._wake.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is _STOP:
+                        self._shutdown(buckets)
+                        return
+                    key = (nxt.task, nxt.image.shape)
+                    buckets.setdefault(key, []).append(nxt)
+            self._expire(buckets)
+            self._dispatch_ready(buckets)
+            self._m_depth.set(sum(len(v) for v in buckets.values()))
+
+    def _next_wait(self, buckets) -> float:
+        """Sleep until the earliest pending cutoff (bounded), or idle."""
+        if not any(buckets.values()):
+            return 0.25
+        now = self._clock()
+        earliest = min(
+            self._cutoff(e) for v in buckets.values() for e in v
+        )
+        return max(min(earliest - now, 0.25), 0.0005)
+
+    def _expire(self, buckets) -> None:
+        """Fail entries whose deadline already passed while pending —
+        they must not occupy dispatch slots."""
+        now = self._clock()
+        for key, entries in buckets.items():
+            keep = []
+            for e in entries:
+                if e.deadline is not None and now > e.deadline:
+                    self._expired += 1
+                    self._dec(1)
+                    if e.tr is not None:
+                        self._tracer.finish(e.tr, "deadline")
+                    e.fut.set_exception(
+                        DeadlineExceededError(
+                            "request deadline passed in scheduler accumulator"
+                        )
+                    )
+                else:
+                    keep.append(e)
+            buckets[key] = keep
+
+    def _dispatch_ready(self, buckets) -> None:
+        while True:
+            now = self._clock()
+            best_key, best_score, best_reason = None, None, None
+            for key, entries in buckets.items():
+                if not entries:
+                    continue
+                full = len(entries) >= self.max_batch
+                past_cutoff = any(self._cutoff(e) <= now for e in entries)
+                if not (full or past_cutoff):
+                    continue
+                occ = min(len(entries) / self.max_batch, 1.0)
+                oldest = max(now - e.t_submit for e in entries)
+                weight = max(
+                    CLASS_WEIGHT.get(e.tclass, CLASS_WEIGHT["batch"])
+                    if e.tclass is not None
+                    else CLASS_WEIGHT["batch"]
+                    for e in entries
+                )
+                score = occ + oldest / self.max_delay + weight
+                if best_score is None or score > best_score:
+                    best_key, best_score = key, score
+                    best_reason = "full" if full else "cutoff"
+            if best_key is None:
+                return
+            self._dispatch_bucket(buckets, best_key, best_reason)
+
+    def _take_batch(
+        self, entries: list[_Entry], reason: str
+    ) -> tuple[list[_Entry], str]:
+        """Pull up to max_batch entries, highest class first (FIFO within
+        a class) — the over-full case is where priority jumps the queue.
+
+        A cutoff-triggered partial batch is **bucket-aligned** when it
+        can be: the engine pads every flush to a power-of-2 bucket, so
+        dispatching 11 entries computes 16 rows while dispatching 8 and
+        holding the 3 youngest (still inside their own cutoffs, now
+        seeding the next batch) computes 8 — same latency for the due
+        entries, zero pad. Alignment never holds a due entry back: if
+        more entries are past cutoff than the floor bucket holds, the
+        whole accumulator flushes padded.
+        """
+        n = min(len(entries), self.max_batch)
+        if reason == "cutoff" and len(entries) < self.max_batch:
+            now = self._clock()
+            due = sum(1 for e in entries if self._cutoff(e) <= now)
+            fb = floor_bucket(len(entries), self.max_batch)
+            if fb < len(entries) and due <= fb:
+                n = fb
+                reason = "aligned"
+        if n == len(entries):
+            batch = list(entries)
+            entries.clear()
+            return batch, reason
+        rank = {c: i for i, c in enumerate(CLASSES)}
+        now = self._clock()
+        # over-full: the highest class takes the slots (the queue jump).
+        # aligned hold-back: due entries go first regardless of class —
+        # alignment must never hold back an entry whose budget is spent
+        due_first = reason == "aligned"
+        order = sorted(
+            range(len(entries)),
+            key=lambda i: (
+                (0 if self._cutoff(entries[i]) <= now else 1)
+                if due_first
+                else 0,
+                rank.get(entries[i].tclass, rank["batch"]),
+                entries[i].t_submit,
+            ),
+        )
+        chosen = set(order[:n])
+        # a jump = a chosen entry that arrived after an unchosen one
+        arrival_cut = sorted(range(len(entries)))[:n]
+        jumps = len(chosen - set(arrival_cut))
+        if jumps:
+            self._m_jumps.inc(jumps)
+        batch = [entries[i] for i in sorted(chosen)]
+        entries[:] = [e for i, e in enumerate(entries) if i not in chosen]
+        return batch, reason
+
+    def _dispatch_bucket(self, buckets, key, reason: str) -> None:
+        batch, reason = self._take_batch(buckets[key], reason)
+        if not batch:
+            return
+        self._dec(len(batch))
+        self._m_batches.labels(reason).inc()
+        self._m_occupancy.observe(len(batch) / self.max_batch)
+        self._occ.observe(len(batch))
+        self._dispatched += len(batch)
+        items = [(e.image, e.deadline, e.meta, e.tr) for e in batch]
+        try:
+            backend_futs = self._dispatch(items)
+        except BaseException as e:  # noqa: BLE001 — backend finished the traces; we fail the futures
+            for entry in batch:
+                entry.fut.set_exception(e)
+            return
+        for entry, bfut in zip(batch, backend_futs):
+            bfut.add_done_callback(self._chain(entry.fut))
+
+    @staticmethod
+    def _chain(caller_fut: Future):
+        def copy(bfut: Future) -> None:
+            exc = bfut.exception()
+            if exc is not None:
+                caller_fut.set_exception(exc)
+            else:
+                caller_fut.set_result(bfut.result())
+
+        return copy
+
+    def _dec(self, k: int) -> None:
+        with self._depth_lock:
+            self._depth -= k
+
+    def _shutdown(self, buckets) -> None:
+        # sweep racers enqueued behind the stop sentinel
+        while True:
+            try:
+                item = self._wake.get_nowait()
+            except queue.Empty:
+                break
+            if item is _STOP:
+                continue
+            buckets.setdefault((item.task, item.image.shape), []).append(item)
+        if not self._drain:
+            # graceful: flush what we have, then stop
+            for key in list(buckets):
+                while buckets[key]:
+                    self._dispatch_bucket(buckets, key, "close")
+            return
+        for entries in buckets.values():
+            for e in entries:
+                self._dec(1)
+                if e.tr is not None:
+                    self._tracer.finish(e.tr, "shutdown")
+                e.fut.set_exception(
+                    ShutdownError("ContinuousScheduler closed")
+                )
+            entries.clear()

@@ -300,6 +300,7 @@ def test_batcher_stats_snapshot(tmp_path):
     assert s["queue_bytes"] == 0
     assert set(s) == {
         "queue_depth", "queue_bytes", "batch_occupancy",
+        "last_batch_occupancy", "window_batch_occupancy",
         "mean_batch_occupancy", "requests_submitted", "requests_shed",
         "shed_rate",
     }

@@ -1,0 +1,527 @@
+#!/usr/bin/env python
+"""Scripted load generator for the traffic-shaping tier — demo + CI harness.
+
+Replays a deterministic (seeded) arrival schedule against an in-process
+replica pool with the full serving stack in front of it: tenant-weighted
+admission, the continuous scheduler (or the FIFO baseline for A/B), and
+optionally the autoscaler. Three traffic profiles:
+
+- ``steady``  — constant ``--base-rps``;
+- ``diurnal`` — one sinusoidal day: base → peak → base across the run
+  (the autoscaler's 2→N→2 script);
+- ``flash``   — base rate with a flash crowd at ``--peak-rps`` through
+  the middle 40–60% of the run (the shed-the-scavengers script).
+
+The pool serves a *modeled* engine by default — per-batch service time
+``overhead + k·per_item`` (so batching genuinely pays, and the A/B
+occupancy win shows up in wall-clock) with power-of-2 bucket padding for
+the pad-fraction accounting; ``--config`` swaps in a real
+``InferenceEngine``. Results go three places: a JSON report (``--out``),
+the access log (``--access-log``, readable by ``tools/serve_doctor.py``),
+and one ``obs/perfledger`` row per run (``--bench-history``) so
+``tools/perf_doctor.py`` regression-gates serving latency/throughput the
+same way it gates training.
+
+    python tools/loadgen.py --profile flash --duration-s 20 --seed 7 \
+        --base-rps 12 --peak-rps 160 --replicas 2 --autoscale 2:4 \
+        --tenants 'web=interactive,scrape=batch:rate=8' \
+        --scheduler continuous --slo 'p99_latency_ms<=2000' \
+        --access-log /tmp/lg/access --out /tmp/lg/result.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import tempfile
+import time
+from concurrent.futures import wait
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# ---------------------------------------------------------------- schedule
+
+
+def rate_at(
+    profile: str, t: float, duration_s: float, base_rps: float, peak_rps: float
+) -> float:
+    """Offered load (req/s) at offset ``t`` into the run."""
+    if profile == "steady":
+        return base_rps
+    if profile == "diurnal":
+        # one full day: trough at both ends, peak mid-run
+        phase = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / duration_s))
+        return base_rps + (peak_rps - base_rps) * phase
+    if profile == "flash":
+        lo, hi = 0.4 * duration_s, 0.6 * duration_s
+        return peak_rps if lo <= t < hi else base_rps
+    raise ValueError(f"unknown profile {profile!r}")
+
+
+def build_schedule(
+    profile: str,
+    duration_s: float,
+    base_rps: float,
+    peak_rps: float,
+    mix: list[tuple[str, float]],
+    seed: int,
+) -> list[tuple[float, str]]:
+    """Deterministic arrival schedule: ``[(t_offset, tenant), ...]`` with
+    exponential inter-arrivals at the profile's instantaneous rate and
+    tenants drawn by their mix share."""
+    rng = np.random.RandomState(seed)
+    names = [name for name, _ in mix]
+    shares = np.asarray([share for _, share in mix], dtype=np.float64)
+    shares = shares / shares.sum()
+    out: list[tuple[float, str]] = []
+    t = 0.0
+    while True:
+        rate = max(rate_at(profile, t, duration_s, base_rps, peak_rps), 1e-3)
+        t += float(rng.exponential(1.0 / rate))
+        if t >= duration_s:
+            return out
+        out.append((t, names[int(rng.choice(len(names), p=shares))]))
+
+
+def parse_mix(spec: str, tenant_names: list[str]) -> list[tuple[str, float]]:
+    """``web=0.7,scrape=0.3`` → shares; default: equal across tenants."""
+    if not spec:
+        return [(n, 1.0) for n in tenant_names]
+    mix = []
+    for entry in spec.split(","):
+        name, _, share = entry.partition("=")
+        mix.append((name.strip(), float(share)))
+    return mix
+
+
+# ------------------------------------------------------------ model engine
+
+
+def bucket_of(k: int, max_batch: int) -> int:
+    b = 1
+    while b < k:
+        b *= 2
+    return min(b, max_batch)
+
+
+class _ModelEngine:
+    """Service-time model standing in for an InferenceEngine: a flush of k
+    items costs ``overhead + bucket(k)·per_item`` (padded rows compute
+    too — that is exactly the waste the continuous scheduler removes)."""
+
+    def __init__(self, overhead_s: float, per_item_s: float, max_batch: int):
+        self.overhead_s = overhead_s
+        self.per_item_s = per_item_s
+        self.max_batch = max_batch
+        self.last_k = 0
+
+    def run(self, batch: np.ndarray) -> np.ndarray:
+        k = len(batch)
+        self.last_k = k
+        b = bucket_of(k, self.max_batch)
+        time.sleep(self.overhead_s + b * self.per_item_s)
+        return batch * 2.0
+
+    def breakdown(self) -> dict:
+        k = self.last_k
+        b = bucket_of(k, self.max_batch) if k else 0
+        return {
+            "compute_s": self.overhead_s + b * self.per_item_s,
+            "bucket": b,
+            "pad_fraction": (b - k) / b if b else 0.0,
+        }
+
+
+# -------------------------------------------------------------------- run
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--profile", choices=("steady", "diurnal", "flash"), default="steady"
+    )
+    p.add_argument("--duration-s", type=float, default=10.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--base-rps", type=float, default=20.0)
+    p.add_argument("--peak-rps", type=float, default=120.0)
+    p.add_argument(
+        "--tenants",
+        default="web=interactive,scrape=batch",
+        help="name=class[:rate=N][:burst=N],... (serve/admission.py spec)",
+    )
+    p.add_argument(
+        "--mix", default="", help="tenant arrival shares, e.g. web=0.7,scrape=0.3"
+    )
+    p.add_argument(
+        "--scheduler",
+        choices=("fifo", "continuous"),
+        default="continuous",
+        help="fifo = per-replica MicroBatcher coalescing (baseline); "
+        "continuous = the serve/scheduler.py accumulator in front",
+    )
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--autoscale", default="", metavar="MIN:MAX")
+    p.add_argument("--autoscale-interval-s", type=float, default=1.0)
+    p.add_argument(
+        "--cooldown-s",
+        type=float,
+        default=0.0,
+        help="idle time after the replay before teardown — lets the "
+        "autoscaler observe the lull and complete the scale-down leg",
+    )
+    p.add_argument("--max-batch", type=int, default=16)
+    p.add_argument("--max-delay-ms", type=float, default=10.0)
+    p.add_argument("--max-queue", type=int, default=64)
+    p.add_argument("--deadline-ms", type=float, default=None)
+    p.add_argument("--slo", default="", metavar="SPEC")
+    p.add_argument("--slo-window-s", type=float, default=10.0)
+    p.add_argument(
+        "--service-overhead-ms",
+        type=float,
+        default=8.0,
+        help="modeled per-flush fixed cost (dispatch + fetch)",
+    )
+    p.add_argument(
+        "--service-per-item-ms",
+        type=float,
+        default=1.5,
+        help="modeled per-bucket-row cost (padded rows pay too)",
+    )
+    p.add_argument("--config", default="", help="YAML recipe: use a real engine")
+    p.add_argument("--task", default="features")
+    p.add_argument("--access-log", default="", metavar="DIR")
+    p.add_argument(
+        "--bench-history",
+        default=None,
+        metavar="PATH",
+        help="perfledger path (default $BENCH_HISTORY; off/0/none disables)",
+    )
+    p.add_argument("--out", default="", help="JSON report path")
+    return p
+
+
+def main(argv: list[str] | None = None) -> dict:
+    args = build_parser().parse_args(argv)
+
+    from jumbo_mae_tpu_tpu.infer.replicaset import ReplicaSet
+    from jumbo_mae_tpu_tpu.obs import AccessLog, RequestTracer
+    from jumbo_mae_tpu_tpu.obs.journal import read_journal
+    from jumbo_mae_tpu_tpu.obs.perfledger import (
+        append_row,
+        make_row,
+        resolve_history_path,
+    )
+    from jumbo_mae_tpu_tpu.serve import (
+        AdmissionController,
+        Autoscaler,
+        ContinuousScheduler,
+        parse_tenants,
+    )
+
+    tenants = parse_tenants(args.tenants)
+    mix = parse_mix(args.mix, [t.name for t in tenants])
+    schedule = build_schedule(
+        args.profile, args.duration_s, args.base_rps, args.peak_rps,
+        mix, args.seed,
+    )
+    print(
+        f"[loadgen] {args.profile}: {len(schedule)} arrivals over "
+        f"{args.duration_s:g}s (seed {args.seed}, scheduler {args.scheduler})"
+    )
+
+    if not args.access_log:
+        # latency quantiles and per-tenant stats are derived from the access
+        # log, so always keep one — scratch dir when the caller didn't ask
+        args.access_log = tempfile.mkdtemp(prefix="loadgen-access-")
+    access = AccessLog(args.access_log)
+    slo_tracker = None
+    if args.slo:
+        from jumbo_mae_tpu_tpu.obs import SLOTracker, parse_slo
+
+        slo_tracker = SLOTracker(
+            parse_slo(args.slo), window_s=args.slo_window_s
+        )
+    tracer = RequestTracer(
+        access_log=access,
+        on_finish=(
+            slo_tracker.observe_trace if slo_tracker is not None else None
+        ),
+    )
+
+    flush_sizes: list[int] = []
+    if args.config:
+        from jumbo_mae_tpu_tpu.config import load_config
+        from jumbo_mae_tpu_tpu.infer import InferenceEngine
+
+        cfg = load_config(args.config, [])
+
+        def provider(idx):
+            return InferenceEngine(cfg, max_batch=args.max_batch)
+
+        def run(engine, batch, metas):
+            flush_sizes.append(len(batch))
+            return engine.predict(batch, task=args.task)
+
+        def breakdown(engine):
+            return engine.last_breakdown()
+
+        probe_engine = provider(0)
+        size = probe_engine.image_size
+        image = (
+            np.random.RandomState(args.seed)
+            .randint(0, 256, (size, size, 3))
+            .astype(np.uint8)
+        )
+        capacity_fn = None
+    else:
+        overhead = args.service_overhead_ms / 1000.0
+        per_item = args.service_per_item_ms / 1000.0
+
+        def provider(idx):
+            return _ModelEngine(overhead, per_item, args.max_batch)
+
+        def run(engine, batch, metas):
+            flush_sizes.append(len(batch))
+            return engine.run(batch)
+
+        def breakdown(engine):
+            return engine.breakdown()
+
+        image = np.ones((8, 8), dtype=np.float32)
+
+        def capacity_fn():
+            # the model's own roofline: a full bucket amortizes overhead
+            full = overhead + args.max_batch * per_item
+            return args.max_batch / full
+
+    # continuous mode: the scheduler's accumulator is the admission-visible
+    # queue; the pool gets headroom above it so a dispatched group doesn't
+    # race the pool's own hard cap (which would shed already-admitted
+    # interactive requests)
+    pool_queue = args.max_queue
+    if args.scheduler == "continuous" and pool_queue is not None:
+        pool_queue = pool_queue + 2 * args.max_batch
+    rs = ReplicaSet(
+        provider,
+        run,
+        replicas=args.replicas,
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        max_queue=pool_queue,
+        tracer=tracer,
+        task=args.task,
+        breakdown=breakdown,
+    )
+    admission = AdmissionController(tenants)
+    sched = None
+    if args.scheduler == "continuous":
+        sched = ContinuousScheduler(
+            rs.submit_group,
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            max_queue=args.max_queue,
+            admission=admission,
+            tracer=tracer,
+            task=args.task,
+        )
+        # combined pressure: the scheduler's accumulator AND the pool's
+        # backlog — either one filling up should start shedding low classes
+        # before interactive traffic hits a hard queue-full
+        admission.set_pressure_fn(
+            lambda: max(sched.pressure(), rs.pressure())
+        )
+    else:
+        admission.set_pressure_fn(rs.pressure)
+
+    autoscaler = None
+    if args.autoscale:
+        lo, hi = (int(x) for x in args.autoscale.split(":"))
+        autoscaler = Autoscaler(
+            rs,
+            min_replicas=lo,
+            max_replicas=hi,
+            interval_s=args.autoscale_interval_s,
+            slo=slo_tracker,
+            capacity_fn=capacity_fn,
+            tracer=tracer,
+        )
+
+    # ------------------------------------------------------------- replay
+    futs = []
+    shed = 0
+    t0 = time.monotonic()
+    for t_offset, tenant in schedule:
+        delay = t0 + t_offset - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            if sched is not None:
+                futs.append(
+                    sched.submit(
+                        image, deadline_ms=args.deadline_ms, tenant=tenant
+                    )
+                )
+            else:
+                sp = admission.admit(tenant)
+                futs.append(
+                    rs.submit(
+                        image,
+                        deadline_ms=args.deadline_ms,
+                        tenant=tenant,
+                        tclass=sp.tclass,
+                    )
+                )
+        except Exception:  # noqa: BLE001 — typed sheds are the measurement
+            shed += 1
+    wall = time.monotonic() - t0
+    done, not_done = wait(futs, timeout=30.0)
+    if args.cooldown_s > 0:
+        time.sleep(args.cooldown_s)
+    ok = failed = 0
+    for f in done:
+        if f.exception() is None:
+            ok += 1
+        else:
+            failed += 1
+    if autoscaler is not None:
+        autoscaler.close()
+    if sched is not None:
+        sched.close()
+    rs.close()
+    tracer.close()
+
+    # ------------------------------------------------------------- report
+    sizes = np.asarray(flush_sizes, dtype=np.float64)
+    occupancy_mean = float(sizes.mean() / args.max_batch) if len(sizes) else 0.0
+    # aggregate compute waste: fraction of device rows that were padding
+    # (a per-batch mean would weight a 2-item flush equally with a full one)
+    dev_rows = sum(bucket_of(int(k), args.max_batch) for k in flush_sizes)
+    pad_mean = float((dev_rows - sizes.sum()) / dev_rows) if dev_rows else 0.0
+    size_hist: dict[int, int] = {}
+    for k in flush_sizes:
+        size_hist[int(k)] = size_hist.get(int(k), 0) + 1
+
+    per_tenant: dict[str, dict] = {}
+    try:
+        rows = read_journal(args.access_log) if args.access_log else []
+    except FileNotFoundError:
+        rows = []
+    req_rows = [r for r in rows if r.get("type") == "request"]
+    for r in req_rows:
+        t = per_tenant.setdefault(
+            r.get("tenant", "?"),
+            {"class": r.get("class"), "requests": 0, "ok": 0, "shed": 0,
+             "lat_ms": []},
+        )
+        t["requests"] += 1
+        if r["outcome"] == "ok":
+            t["ok"] += 1
+            t["lat_ms"].append(r["lat_ms"])
+        elif r["outcome"] == "shed":
+            t["shed"] += 1
+    for t in per_tenant.values():
+        lats = sorted(t.pop("lat_ms"))
+        t["p50_ms"] = round(lats[len(lats) // 2], 2) if lats else None
+        t["p99_ms"] = (
+            round(lats[min(len(lats) - 1, int(0.99 * len(lats)))], 2)
+            if lats
+            else None
+        )
+
+    all_lat = sorted(
+        r["lat_ms"] for r in req_rows if r["outcome"] == "ok"
+    )
+
+    def q(p: float):
+        if not all_lat:
+            return None
+        return round(all_lat[min(len(all_lat) - 1, int(p * len(all_lat)))], 2)
+
+    interactive_ok = True
+    slo_report = None
+    if slo_tracker is not None:
+        slo_report = slo_tracker.evaluate()
+        inter = {t.name for t in tenants if t.tclass == "interactive"}
+        for obj in slo_tracker.objectives:
+            if obj.percentile is None:
+                continue
+            for name in inter:
+                p99 = per_tenant.get(name, {}).get("p99_ms")
+                if p99 is not None and p99 > obj.threshold:
+                    interactive_ok = False
+
+    result = {
+        "profile": args.profile,
+        "scheduler": args.scheduler,
+        "seed": args.seed,
+        "duration_s": round(wall, 3),
+        "offered": len(schedule),
+        "ok": ok,
+        "shed_at_submit": shed,
+        "failed": failed,
+        "unresolved": len(not_done),
+        # in-flight drops: admitted requests the pool abandoned (anything
+        # failed that is not an admission shed or a deadline miss)
+        "dropped_in_flight": sum(
+            1 for r in req_rows
+            if r["outcome"] in ("aborted", "shutdown")
+        ),
+        "req_per_sec": round(ok / wall, 2) if wall > 0 else 0.0,
+        "p50_ms": q(0.50),
+        "p99_ms": q(0.99),
+        "occupancy_mean": round(occupancy_mean, 4),
+        "pad_mean": round(pad_mean, 4),
+        "batches": len(flush_sizes),
+        "size_hist": {k: size_hist[k] for k in sorted(size_hist)},
+        "tenants": per_tenant,
+        "admission": admission.stats(),
+        "autoscale_events": (
+            list(autoscaler.events) if autoscaler is not None else []
+        ),
+        "interactive_slo_ok": interactive_ok,
+        "slo": slo_report,
+    }
+    print(
+        f"[loadgen] ok={ok} shed_at_submit={shed} "
+        f"failed={failed} occ={result['occupancy_mean']} "
+        f"pad={result['pad_mean']} p99={result['p99_ms']}ms "
+        f"autoscale_events={len(result['autoscale_events'])}"
+    )
+
+    history = resolve_history_path(args.bench_history)
+    if history is not None and ok:
+        row = make_row(
+            bench="serve",
+            metric=f"loadgen_{args.profile}_{args.scheduler}",
+            legs={
+                "req_per_sec": result["req_per_sec"],
+                "p50_ms": result["p50_ms"],
+                "p99_ms": result["p99_ms"],
+                "occupancy_mean": result["occupancy_mean"],
+            },
+            quantiles={"p50_ms": result["p50_ms"], "p99_ms": result["p99_ms"]},
+            extra={
+                "pad_mean": result["pad_mean"],
+                "profile": args.profile,
+                "scheduler": args.scheduler,
+                "seed": args.seed,
+            },
+        )
+        if append_row(history, row):
+            print(f"[loadgen] ledger row -> {history}")
+
+    if args.out:
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(result, indent=2, default=str))
+        print(f"[loadgen] report -> {out}")
+    return result
+
+
+if __name__ == "__main__":
+    main()

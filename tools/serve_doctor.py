@@ -22,8 +22,13 @@ With a replica-pool access log (``--serve --replicas N``) the report adds
 a per-replica latency/outcome table (keyed on each row's ``replica``
 field), retry clusters naming the replica whose failure forced each
 requeue (``requeued_from``) and who absorbed the retries, and a pool
-event timeline — crashes, hangs, restarts, breaker flips, and weight-swap
-verdicts (a ``swap_rollback`` also lands in the Verdict line).
+event timeline — crashes, hangs, restarts, breaker flips, autoscale
+resizes, and weight-swap verdicts (a ``swap_rollback`` also lands in the
+Verdict line). Rows carrying ``tenant``/``class`` (the traffic-shaping
+tier) add a per-tenant table plus a shaping-vs-starvation verdict: low
+classes shedding first is the design working; a shed *interactive*
+tenant while lower classes kept being served is priority inversion and
+is called out as starvation.
 
 Without ``--slo`` the slow-request threshold defaults to 4x the median ok
 latency — a shape-based heuristic for "what would have annoyed a caller",
@@ -295,6 +300,69 @@ def diagnose(
                 )
             lines.append("")
 
+    # ------------------------------------------------------------- tenants
+    ten_rows = [r for r in rows if r.get("tenant") is not None]
+    if ten_rows:
+        by_ten: dict[str, list[dict]] = {}
+        for r in ten_rows:
+            by_ten.setdefault(str(r["tenant"]), []).append(r)
+        lines += [
+            "## Tenants",
+            "",
+            "| tenant | class | requests | ok | shed | p50 ms | p99 ms |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        shed_by_ten: dict[str, int] = {}
+        class_of: dict[str, str] = {}
+        for name in sorted(by_ten):
+            sel = by_ten[name]
+            tclass = next(
+                (str(r["class"]) for r in sel if r.get("class")), "?"
+            )
+            class_of[name] = tclass
+            oks = [r for r in sel if r["outcome"] == "ok"]
+            lat = sorted(
+                r["lat_ms"] for r in oks if r.get("lat_ms") is not None
+            )
+            shed_n = sum(1 for r in sel if r["outcome"] == "shed")
+            shed_by_ten[name] = shed_n
+            lines.append(
+                f"| {name} | {tclass} | {len(sel)} | {len(oks)} | {shed_n} "
+                f"| {fmt_num(_quantile(lat, 0.50)) if lat else '-'} "
+                f"| {fmt_num(_quantile(lat, 0.99)) if lat else '-'} |"
+            )
+        lines.append("")
+        # shaping vs starvation: shedding *low* classes under pressure is
+        # the design working; a shed interactive tenant while lower
+        # classes kept being served is priority inversion
+        class_rank = {"interactive": 0, "batch": 1, "scavenger": 2}
+        shed_tenants = [t for t, n in shed_by_ten.items() if n > 0]
+        starved = [
+            t for t in shed_tenants
+            if class_rank.get(class_of[t], 1) == 0
+            and any(
+                class_rank.get(class_of[o], 1) > 0
+                and sum(1 for r in by_ten[o] if r["outcome"] == "ok") > 0
+                for o in by_ten
+                if o != t
+            )
+        ]
+        if starved:
+            verdict.append(
+                "**starvation**: interactive tenant(s) "
+                + ", ".join(f"`{t}`" for t in sorted(starved))
+                + " shed while lower classes were served"
+            )
+        elif shed_tenants:
+            verdict.append(
+                "shaping shed "
+                + ", ".join(
+                    f"`{t}` ({class_of[t]}, {shed_by_ten[t]})"
+                    for t in sorted(shed_tenants)
+                )
+                + " — low classes gave way first"
+            )
+
     # ------------------------------------------------- non-ok rid clusters
     bad = [r for r in rows if r["outcome"] not in ("ok",)]
     if bad:
@@ -314,7 +382,7 @@ def diagnose(
         "replica_crash", "replica_hang", "replica_restart",
         "replica_restart_failed", "breaker_open", "breaker_close",
         "swap_start", "swap_canary", "swap_rejected", "swap_rollback",
-        "swap_promoted",
+        "swap_promoted", "autoscale", "replica_added", "replica_removed",
     )
     pool_ev = [
         e for e in (events or []) if e.get("type") in POOL_EVENTS
