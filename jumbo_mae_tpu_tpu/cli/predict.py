@@ -105,8 +105,9 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         metavar="SPEC",
         help="--replicas: traffic shaping — weighted multi-tenant admission "
-        "plus continuous batching. Comma-separated "
-        "name=class[:rate=N][:burst=N] entries (classes: "
+        "plus continuous batching and per-tenant cost metering. "
+        "Comma-separated name=class[:rate=N][:burst=N][:budget=D][:window=W] "
+        "entries (budget = device-seconds per window; classes: "
         "interactive|batch|scavenger); requests round-robin across tenants, "
         "low classes shed first under pressure, and the continuous "
         "scheduler coalesces late arrivals into pending batches",
@@ -570,16 +571,24 @@ def main(argv: list[str] | None = None) -> Path | None:
         sched = None
         admission = None
         tenant_names: list[str] = []
+        meter = None
         if args.tenants:
             from jumbo_mae_tpu_tpu.serve import (
                 AdmissionController,
                 ContinuousScheduler,
+                CostMeter,
                 parse_tenants,
             )
 
             tenant_specs = parse_tenants(args.tenants)
             tenant_names = [t.name for t in tenant_specs]
-            admission = AdmissionController(tenant_specs)
+            # meter every dispatched batch: per-tenant device-seconds/FLOPs
+            # ledgers feed serve_tenant_* metrics, tenant_usage journal
+            # rows, the access log's device_ms/cost_flops columns, and the
+            # budget= checks below
+            meter = CostMeter(tenant_specs, tracer=tracer)
+            rs.set_costmeter(meter)
+            admission = AdmissionController(tenant_specs, meter=meter)
             # the scheduler's accumulator becomes the admission-visible
             # queue; give the pool headroom above it so a dispatched group
             # doesn't race the pool's own hard cap and shed an
@@ -720,6 +729,18 @@ def main(argv: list[str] | None = None) -> Path | None:
             sched.close()
             if admission is not None:
                 print(f"[predict] admission: {json.dumps(admission.stats())}")
+        if meter is not None:
+            meter.flush()  # final tenant_usage rows before the log closes
+            bill = meter.snapshot()
+            costs = ", ".join(
+                f"{t}={b['device_s']:.3f}s"
+                for t, b in bill["tenants"].items()
+            )
+            print(
+                f"[predict] tenant cost: {costs} "
+                f"(total {bill['total_device_s']:.3f} device-s, "
+                f"{bill['total_batches']} batches)"
+            )
         st = rs.stats()
         print(f"[predict] replicas: {json.dumps(st['replicas'])}")
         rs.close()

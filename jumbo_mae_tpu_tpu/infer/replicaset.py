@@ -185,6 +185,7 @@ class ReplicaSet:
         registry=None,
         health=None,
         breakdown: Callable[[Any], dict | None] | None = None,
+        costmeter=None,
         clock: Callable[[], float] = time.monotonic,
     ):
         if replicas < 1:
@@ -215,6 +216,7 @@ class ReplicaSet:
         self.task = task
         self._health = health
         self._breakdown = breakdown
+        self._costmeter = costmeter
         self._clock = clock
         self._observers: list[Callable] = []
 
@@ -531,6 +533,13 @@ class ReplicaSet:
         """Repoint restarts at a new engine source (a promoted swap must
         survive a later replica restart)."""
         self._provider = fn
+
+    def set_costmeter(self, meter) -> None:
+        """Late-bind the tenant cost meter — it is usually built after the
+        pool, next to the admission controller it feeds. Every flushed
+        batch then reports ``(run_s, traces, engine)`` to
+        :meth:`CostMeter.observe_batch`."""
+        self._costmeter = meter
 
     # ------------------------------------------------------------- scaling
 
@@ -947,6 +956,15 @@ class ReplicaSet:
             )
             self._tracer.flush_end(
                 traces, run_s=done - t_run, batch=len(batch), breakdown=bd
+            )
+        if traces and self._costmeter is not None:
+            # before the _finish loop: the stamped device_s/cost_flops
+            # must land on every access-log row this batch produces
+            self._costmeter.observe_batch(
+                run_s=done - t_run,
+                traces=traces,
+                batch=len(batch),
+                engine=rep.engine,
             )
         self._m_latency.observe_many([done - rec.t0 for rec in batch])
         if isinstance(out, dict):

@@ -104,6 +104,32 @@ def cost_asdict(cost: ProgramCost) -> dict:
     return {"cost_schema": COST_SCHEMA_VERSION, **asdict(cost)}
 
 
+def lookup_cost(cost_reports, task: str, bucket: int) -> ProgramCost | None:
+    """Resolve the ``ProgramCost`` for one dispatched ``(task, bucket)``.
+
+    Engine cost tables are keyed ``(task_key, bucket)`` where ``task_key``
+    may be pool-suffixed (``"features/mean"``); the dispatcher only knows
+    the plain task name. Resolution order: exact key, then any key at the
+    same bucket whose task component equals or extends ``task``, then any
+    key at that bucket (single-task engines). ``None`` when the table is
+    empty or the bucket was never compiled — the meter then bills
+    device-time only."""
+    if not cost_reports:
+        return None
+    exact = cost_reports.get((task, int(bucket)))
+    if exact is not None:
+        return exact
+    fallback = None
+    for (key_task, key_bucket), cost in cost_reports.items():
+        if int(key_bucket) != int(bucket):
+            continue
+        if key_task == task or str(key_task).startswith(f"{task}/"):
+            return cost
+        if fallback is None:
+            fallback = cost
+    return fallback
+
+
 _GAUGES = (
     ("xla_flops", "flops", "XLA-counted flops per execution"),
     ("xla_bytes_accessed", "bytes_accessed", "XLA-counted bytes accessed per execution"),

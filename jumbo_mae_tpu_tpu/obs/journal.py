@@ -80,6 +80,7 @@ JOURNAL_EVENTS = frozenset(
         "autoscale",
         "replica_added",
         "replica_removed",
+        "tenant_usage",
     }
 )
 

@@ -59,6 +59,7 @@ class RequestTrace:
         "queue_wait_s", "admission_s", "compute_s", "fetch_s",
         "batch", "bucket", "pad_fraction", "latency_s", "outcome", "error",
         "replica_id", "retries", "requeued_from", "tenant", "tclass",
+        "device_s", "cost_flops",
     )
 
     def __init__(
@@ -91,6 +92,8 @@ class RequestTrace:
         self.replica_id = None
         self.retries = 0
         self.requeued_from = None
+        self.device_s = None
+        self.cost_flops = None
 
 
 class AccessLog:
@@ -274,6 +277,8 @@ class RequestTracer:
                 ("batch", tr.batch),
                 ("bucket", tr.bucket),
                 ("pad", tr.pad_fraction),
+                ("device_ms", _ms(tr.device_s)),
+                ("cost_flops", tr.cost_flops),
                 ("deadline_ms", tr.deadline_ms),
                 ("tenant", tr.tenant),
                 ("class", tr.tclass),

@@ -1,12 +1,14 @@
 """Traffic-shaping tier: the scheduling brain between request submission
 and the replicated serving pool.
 
-Three cooperating components (ROADMAP open item 2 — the gap between
+Four cooperating components (ROADMAP open item 2 — the gap between
 "survives crashes" and "serves millions of users"):
 
 - :mod:`~jumbo_mae_tpu_tpu.serve.admission` — per-tenant token-bucket
   quotas and priority classes (``interactive`` > ``batch`` >
-  ``scavenger``): under pressure, low-priority tenants shed *first*.
+  ``scavenger``): under pressure, low-priority tenants shed *first*;
+  budgeted tenants degrade to scavenger-class shedding once their
+  device-second budget is spent.
 - :mod:`~jumbo_mae_tpu_tpu.serve.scheduler` — continuous batching:
   per-(task, shape-bucket) accumulators admit late arrivals into
   partially-filled pending batches up to a deadline-aware cutoff, and the
@@ -16,17 +18,25 @@ Three cooperating components (ROADMAP open item 2 — the gap between
   (``obs/perfmodel``) into a target replica count, actuated through
   :meth:`ReplicaSet.scale_to` (scale-down drains; never kills in-flight
   work).
+- :mod:`~jumbo_mae_tpu_tpu.serve.costmeter` — per-tenant usage metering:
+  every dispatched batch's wall-time and executable FLOPs are split
+  pro-rata across occupied rows into per-tenant ledgers (pad waste
+  attributed separately), feeding ``serve_tenant_*`` metrics, per-row
+  ``device_ms``/``cost_flops`` access-log columns, ``tenant_usage``
+  journal events, and the admission gate's ``budget=`` enforcement.
 """
 
 from jumbo_mae_tpu_tpu.serve.admission import (
     CLASSES,
     AdmissionController,
+    TenantBudgetError,
     TenantPressureError,
     TenantQuotaError,
     TenantSpec,
     parse_tenants,
 )
 from jumbo_mae_tpu_tpu.serve.autoscaler import Autoscaler, roofline_capacity
+from jumbo_mae_tpu_tpu.serve.costmeter import CostMeter, default_cost_fn
 from jumbo_mae_tpu_tpu.serve.scheduler import ContinuousScheduler
 
 __all__ = [
@@ -34,9 +44,12 @@ __all__ = [
     "AdmissionController",
     "Autoscaler",
     "ContinuousScheduler",
+    "CostMeter",
+    "TenantBudgetError",
     "TenantPressureError",
     "TenantQuotaError",
     "TenantSpec",
+    "default_cost_fn",
     "parse_tenants",
     "roofline_capacity",
 ]

@@ -11,7 +11,7 @@ classes shed first —
 - ``batch`` — throughput traffic; sheds when the pool is clearly loaded;
 - ``scavenger`` — best-effort backfill; sheds at the first sign of load.
 
-Two independent shed reasons, both subclasses of the pool's
+Three independent shed reasons, all subclasses of the pool's
 :class:`~jumbo_mae_tpu_tpu.infer.batching.QueueFullError` so existing
 callers' shed handling works unchanged:
 
@@ -19,7 +19,14 @@ callers' shed handling works unchanged:
   empty — it exceeded its contracted rate, regardless of pool load;
 - **pressure** (:class:`TenantPressureError`): the pool-wide pressure
   signal (queue depth / max_queue, supplied by the scheduler) crossed the
-  class's shed threshold — the pool is protecting higher classes.
+  class's shed threshold — the pool is protecting higher classes;
+- **budget** (:class:`TenantBudgetError`): the tenant spent its
+  ``budget=`` device-seconds over its accounting window (per the attached
+  :class:`~jumbo_mae_tpu_tpu.serve.costmeter.CostMeter`), so it degrades
+  to *scavenger-class* pressure sensitivity — it sheds at half load like
+  any other best-effort tenant, but is never shed at zero pressure: a
+  budget bounds a tenant's claim on contended capacity, it is not a hard
+  kill switch.
 
 Token buckets refill continuously at ``rate`` tokens/s up to ``burst``;
 a tenant with no rate is unmetered (class pressure still applies). The
@@ -56,14 +63,22 @@ class TenantPressureError(QueueFullError):
     """Pool pressure crossed this tenant's class shed threshold."""
 
 
+class TenantBudgetError(QueueFullError):
+    """The tenant exhausted its device-second budget and the pool is
+    contended — shed at scavenger-class pressure until the window rolls."""
+
+
 @dataclass(frozen=True)
 class TenantSpec:
-    """One tenant's contract: priority class + optional rate limit."""
+    """One tenant's contract: priority class + optional rate limit +
+    optional device-second budget."""
 
     name: str
     tclass: str = "batch"
     rate: float | None = None     # tokens (requests) per second
     burst: float | None = None    # bucket capacity; defaults to max(rate, 1)
+    budget: float | None = None   # device-seconds per accounting window
+    budget_window_s: float | None = None  # window length; meter default if None
 
     def __post_init__(self):
         if self.tclass not in CLASSES:
@@ -73,15 +88,22 @@ class TenantSpec:
             )
         if self.rate is not None and self.rate <= 0:
             raise ValueError(f"tenant {self.name!r} rate must be > 0")
+        if self.budget is not None and self.budget <= 0:
+            raise ValueError(f"tenant {self.name!r} budget must be > 0")
+        if self.budget_window_s is not None and self.budget_window_s <= 0:
+            raise ValueError(f"tenant {self.name!r} window must be > 0")
 
 
 def parse_tenants(spec: str) -> list[TenantSpec]:
     """Parse the ``--tenants`` flag:
-    ``"web=interactive:rate=50:burst=100,scrape=batch:rate=5"``.
+    ``"web=interactive:rate=50:burst=100,scrape=batch:rate=5:budget=2"``.
 
-    Each comma-separated entry is ``name=class[:rate=N][:burst=N]``;
-    class must be one of :data:`CLASSES`. Typos fail loudly — a silent
-    default would quietly demote a tenant to ``batch``.
+    Each comma-separated entry is
+    ``name=class[:rate=N][:burst=N][:budget=D][:window=W]`` — ``budget``
+    is device-seconds per accounting window, ``window`` its length in
+    seconds (the cost meter's default window when omitted); class must be
+    one of :data:`CLASSES`. Typos fail loudly — a silent default would
+    quietly demote a tenant to ``batch``.
     """
     tenants: list[TenantSpec] = []
     seen: set[str] = set()
@@ -102,7 +124,7 @@ def parse_tenants(spec: str) -> list[TenantSpec]:
         seen.add(name)
         parts = rest.split(":")
         tclass = parts[0].strip()
-        rate = burst = None
+        rate = burst = budget = window = None
         for opt in parts[1:]:
             key, _, val = opt.partition("=")
             key = key.strip()
@@ -110,12 +132,16 @@ def parse_tenants(spec: str) -> list[TenantSpec]:
                 rate = float(val)
             elif key == "burst":
                 burst = float(val)
+            elif key == "budget":
+                budget = float(val)
+            elif key == "window":
+                window = float(val)
             else:
                 raise ValueError(
                     f"unknown tenant option {key!r} in {entry!r} "
-                    f"(rate, burst)"
+                    f"(rate, burst, budget, window)"
                 )
-        tenants.append(TenantSpec(name, tclass, rate, burst))
+        tenants.append(TenantSpec(name, tclass, rate, burst, budget, window))
     if not tenants:
         raise ValueError(f"empty tenant spec {spec!r}")
     return tenants
@@ -158,11 +184,13 @@ class AdmissionController:
         tenants,
         *,
         pressure_fn=None,
+        meter=None,
         registry=None,
         clock=time.monotonic,
     ):
         self._clock = clock
         self._pressure_fn = pressure_fn
+        self._meter = meter
         self._specs = {t.name: t for t in tenants}
         self._lock = lockwatch.lock("serve.admission")
         now = clock()
@@ -180,13 +208,26 @@ class AdmissionController:
         )
         self._m_shed = reg.counter(
             "serve_admit_shed_total",
-            "requests shed at admission by reason (quota|pressure)",
+            "requests shed at admission by reason (quota|pressure|budget)",
             labels=("tenant", "class", "reason"),
         )
         self._m_pressure = reg.gauge(
             "serve_admit_pressure",
             "pool pressure sampled at the last admission decision",
         )
+        self._m_budget_left = reg.gauge(
+            "serve_tenant_budget_remaining",
+            "device-seconds left in the tenant's budget window (budgeted tenants)",
+            labels=("tenant", "class"),
+        )
+        # eager children: every configured tenant is scrapeable (at zero)
+        # from construction, not from its first admit/shed event
+        for sp in self._specs.values():
+            self._m_admitted.labels(sp.name, sp.tclass)
+            for reason in ("quota", "pressure", "budget"):
+                self._m_shed.labels(sp.name, sp.tclass, reason)
+            if sp.budget is not None:
+                self._m_budget_left.labels(sp.name, sp.tclass).set(sp.budget)
         # shed bookkeeping for stats()/tests, by (tenant, reason)
         self._admitted_n: dict[str, int] = {}
         self._shed_n: dict[tuple[str, str], int] = {}
@@ -195,6 +236,11 @@ class AdmissionController:
         """Late-bind the pool pressure probe — the scheduler that supplies
         it usually takes this controller as a constructor argument."""
         self._pressure_fn = fn
+
+    def set_meter(self, meter) -> None:
+        """Late-bind the cost meter that prices ``budget=`` tenants — it
+        is usually built after the controller, next to the replica set."""
+        self._meter = meter
 
     def spec(self, tenant: str | None) -> TenantSpec:
         if tenant is None:
@@ -215,7 +261,9 @@ class AdmissionController:
 
         Pressure is checked before quota: under load, a low class sheds
         even with tokens in the bank — the whole point is protecting the
-        higher classes' capacity.
+        higher classes' capacity. A budgeted tenant that spent its window
+        degrades to scavenger-class pressure sensitivity (never a shed at
+        zero pressure — budgets bound contention, they don't kill).
         """
         sp = self.spec(tenant)
         pressure = self.pressure()
@@ -226,6 +274,22 @@ class AdmissionController:
                 f"tenant {sp.name!r} ({sp.tclass}) shed at pressure "
                 f"{pressure:.2f} >= {CLASS_SHED_PRESSURE[sp.tclass]}"
             )
+        if sp.budget is not None and self._meter is not None:
+            window = sp.budget_window_s
+            used = self._meter.window_usage(sp.name, window)
+            self._m_budget_left.labels(sp.name, sp.tclass).set(
+                max(0.0, sp.budget - used)
+            )
+            if (
+                used >= sp.budget
+                and pressure >= CLASS_SHED_PRESSURE["scavenger"]
+            ):
+                self._shed(sp, "budget")
+                raise TenantBudgetError(
+                    f"tenant {sp.name!r} over budget "
+                    f"({used:.3f}s >= {sp.budget:g}s device-time per window) "
+                    f"at pressure {pressure:.2f}"
+                )
         bucket = self._buckets.get(sp.name)
         if bucket is not None:
             with self._lock:

@@ -173,9 +173,12 @@ class ContinuousScheduler:
     ) -> Future:
         """Admit one request into its (task, shape) accumulator; returns a
         future. Sheds typed: tenant-weighted
-        (:class:`TenantQuotaError` / :class:`TenantPressureError`) when an
-        admission controller is attached, plus the hard
-        :class:`QueueFullError` backstop at ``max_queue``."""
+        (:class:`TenantQuotaError` / :class:`TenantPressureError` /
+        :class:`TenantBudgetError`) when an admission controller is
+        attached, plus the hard :class:`QueueFullError` backstop at
+        ``max_queue``. Typed sheds stamp the subclass name into the
+        access-log row's ``err`` column so offline doctors can split
+        quota vs pressure vs budget sheds."""
         sp = None
         tclass = None
         if self.admission is not None:
@@ -207,7 +210,14 @@ class ContinuousScheduler:
         except BaseException as e:  # noqa: BLE001 — classify, trace, re-raise
             if tr is not None:
                 if isinstance(e, QueueFullError):
-                    self._tracer.finish(tr, "shed")
+                    # subclass name (quota/pressure/budget) rides in err;
+                    # a bare QueueFullError shed stays unannotated
+                    shed_kind = (
+                        type(e).__name__
+                        if type(e) is not QueueFullError
+                        else None
+                    )
+                    self._tracer.finish(tr, "shed", error=shed_kind)
                 elif isinstance(e, ShutdownError) or self._closed:
                     self._tracer.finish(tr, "shutdown")
                 else:

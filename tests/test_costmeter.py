@@ -1,0 +1,480 @@
+"""Tenant cost-accounting contracts (serve/costmeter.py + budget admission).
+
+What the metering layer must guarantee:
+
+- **conservation**: per-tenant device-seconds sum to the measured batch
+  wall-times, and per-tenant FLOPs sum to executable FLOPs x batches —
+  exactly in the unit tests, within 1% end-to-end through the continuous
+  scheduler across aligned / partial / priority-jump dispatch paths and
+  under replica crash faults;
+- **attribution**: padded rows bill the *dispatching* tenants' waste
+  accounts (waste is a split of the total, never on top of it); unknown
+  tenants accrue to ``_default`` rather than vanishing;
+- **stamping**: every ok access-log row carries the meter's ``device_ms``
+  / ``cost_flops`` columns;
+- **budgets**: an over-budget tenant degrades to scavenger-class pressure
+  (shed at 0.5 with a typed :class:`TenantBudgetError`, ``reason=budget``
+  metrics), is still admitted at low pressure, and never affects other
+  tenants' admission;
+- **visibility**: every configured tenant's ``serve_admit_*`` and
+  ``serve_tenant_*`` children render (at zero) from construction, and the
+  meter journals ``tenant_usage`` rows.
+"""
+
+import threading
+import time
+from concurrent.futures import wait
+
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu import faults
+from jumbo_mae_tpu_tpu.obs import AccessLog, RequestTracer
+from jumbo_mae_tpu_tpu.obs.costmodel import ProgramCost, lookup_cost
+from jumbo_mae_tpu_tpu.obs.journal import read_journal
+from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+from jumbo_mae_tpu_tpu.obs.reqtrace import RequestTrace
+from jumbo_mae_tpu_tpu.infer import ReplicaSet
+from jumbo_mae_tpu_tpu.serve import (
+    AdmissionController,
+    ContinuousScheduler,
+    CostMeter,
+    TenantBudgetError,
+    parse_tenants,
+)
+
+
+@pytest.fixture
+def fault_plan():
+    yield faults.install_plan
+    faults.clear_plan()
+
+
+def _img(v=0.0):
+    return np.full((2, 2, 3), v, np.float32)
+
+
+def run_echo(eng, batch, metas):
+    return {"y": batch[:, 0, 0, 0].astype(np.float64)}
+
+
+class StubEngine:
+    def __init__(self, idx):
+        self.idx = idx
+
+
+def make_pool(reg, tracer=None, *, replicas=2, run=run_echo, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_delay_ms", 1.0)
+    kw.setdefault("supervise_interval_s", 0.02)
+    kw.setdefault("restart_backoff_s", 0.05)
+    return ReplicaSet(
+        lambda i: StubEngine(i), run, replicas=replicas, registry=reg,
+        tracer=tracer, **kw,
+    )
+
+
+def _trace(rid, tenant, tclass="batch", *, bucket=None, pad=None, task="t"):
+    tr = RequestTrace(rid, task, None, tenant, tclass)
+    tr.bucket = bucket
+    tr.pad_fraction = pad
+    return tr
+
+
+class RecordingMeter(CostMeter):
+    """CostMeter that also keeps the raw batch-level measurements the
+    ledgers must reconcile against."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.observed: list[tuple[float, int]] = []
+
+    def observe_batch(self, *, run_s, traces, batch, engine=None):
+        if any(tr is not None for tr in traces):
+            self.observed.append((float(run_s), int(batch)))
+        super().observe_batch(
+            run_s=run_s, traces=traces, batch=batch, engine=engine
+        )
+
+
+# ------------------------------------------------------------ unit: meter
+
+
+def test_observe_batch_conserves_time_and_flops_exactly():
+    reg = MetricsRegistry()
+    meter = CostMeter(
+        parse_tenants("a=interactive,b=batch"),
+        registry=reg,
+        cost_fn=lambda eng, task, bucket: {"flops": bucket * 100.0},
+    )
+    # batch of 3 occupied rows in a bucket of 4: pad fraction 0.25
+    traces = [
+        _trace(0, "a", "interactive", bucket=4, pad=0.25),
+        _trace(1, "a", "interactive", bucket=4, pad=0.25),
+        _trace(2, "b", "batch", bucket=4, pad=0.25),
+    ]
+    meter.observe_batch(run_s=0.9, traces=traces, batch=3)
+    snap = meter.snapshot()
+    a, b = snap["tenants"]["a"], snap["tenants"]["b"]
+    # whole wall-time split across occupied rows: 0.3 each
+    assert a["device_s"] == pytest.approx(0.6)
+    assert b["device_s"] == pytest.approx(0.3)
+    assert a["device_s"] + b["device_s"] == pytest.approx(0.9)
+    # whole executable FLOPs (bucket x 100 = 400) split across 3 rows
+    assert a["flops"] + b["flops"] == pytest.approx(400.0)
+    assert snap["total_flops"] == pytest.approx(400.0)
+    # waste is a split of the total: run_s x pad, equally per trace
+    waste = a["waste_device_s"] + b["waste_device_s"]
+    assert waste == pytest.approx(0.9 * 0.25)
+    assert a["waste_device_s"] == pytest.approx(2 * waste / 3)
+    # traces got stamped for the access-log row
+    assert traces[0].device_s == pytest.approx(0.3)
+    assert traces[0].cost_flops == pytest.approx(400.0 / 3)
+    # counters rendered
+    text = reg.render()
+    assert 'serve_tenant_device_seconds_total{tenant="a",class="interactive"}' in text
+    assert 'serve_tenant_requests_total{tenant="b",class="batch"} 1' in text
+
+
+def test_observe_batch_unknown_tenant_accrues_to_default():
+    meter = CostMeter(registry=MetricsRegistry(), cost_fn=None)
+    meter.observe_batch(
+        run_s=0.5, traces=[_trace(0, None, None)], batch=1
+    )
+    snap = meter.snapshot()
+    assert snap["tenants"]["_default"]["device_s"] == pytest.approx(0.5)
+    assert snap["tenants"]["_default"]["requests"] == 1
+
+
+def test_observe_batch_survives_broken_cost_fn_and_bills_time():
+    def boom(engine, task, bucket):
+        raise RuntimeError("no cost table")
+
+    meter = CostMeter(registry=MetricsRegistry(), cost_fn=boom)
+    tr = _trace(0, "a", bucket=2, pad=0.5)
+    meter.observe_batch(run_s=0.2, traces=[tr], batch=1)
+    snap = meter.snapshot()
+    assert snap["tenants"]["a"]["device_s"] == pytest.approx(0.2)
+    assert snap["tenants"]["a"]["flops"] == 0.0
+    assert tr.device_s == pytest.approx(0.2)
+    assert tr.cost_flops is None  # no basis, no column
+
+
+def test_window_usage_prunes_old_samples():
+    t = {"now": 0.0}
+    meter = CostMeter(
+        parse_tenants("a=batch:budget=1:window=60"),
+        registry=MetricsRegistry(),
+        cost_fn=None,
+        clock=lambda: t["now"],
+    )
+    meter.observe_batch(run_s=0.7, traces=[_trace(0, "a")], batch=1)
+    t["now"] = 30.0
+    meter.observe_batch(run_s=0.5, traces=[_trace(1, "a")], batch=1)
+    assert meter.window_usage("a", 60.0) == pytest.approx(1.2)
+    assert meter.over_budget("a")
+    t["now"] = 80.0  # first sample ages out of the 60s window
+    assert meter.window_usage("a", 60.0) == pytest.approx(0.5)
+    assert not meter.over_budget("a")
+    # lifetime ledger keeps both
+    assert meter.snapshot()["tenants"]["a"]["device_s"] == pytest.approx(1.2)
+
+
+def test_meter_journals_tenant_usage_rows(tmp_path):
+    log = AccessLog(tmp_path / "access")
+    tracer = RequestTracer(registry=MetricsRegistry(), access_log=log)
+    meter = CostMeter(
+        parse_tenants("a=batch:budget=0.1"),
+        registry=MetricsRegistry(),
+        cost_fn=None,
+        tracer=tracer,
+    )
+    meter.observe_batch(run_s=0.4, traces=[_trace(0, "a")], batch=1)
+    meter.flush()
+    tracer.close()
+    rows = [
+        r for r in read_journal(tmp_path / "access")
+        if r.get("type") == "tenant_usage"
+    ]
+    assert rows, "flush() must force a tenant_usage emission"
+    last = rows[-1]
+    assert last["tenant"] == "a" and last["class"] == "batch"
+    assert last["device_s"] == pytest.approx(0.4)
+    assert last["budget_device_s"] == pytest.approx(0.1)
+    assert last["over_budget"] is True
+
+
+def test_lookup_cost_resolves_exact_pooled_and_fallback_keys():
+    c1 = ProgramCost("features", flops=10.0)
+    c2 = ProgramCost("features/mean", flops=20.0)
+    c3 = ProgramCost("recon", flops=30.0)
+    table = {("features", 8): c1, ("features/mean", 16): c2, ("recon", 32): c3}
+    assert lookup_cost(table, "features", 8) is c1       # exact
+    assert lookup_cost(table, "features", 16) is c2      # pool-suffixed
+    assert lookup_cost(table, "features", 32) is c3      # same-bucket fallback
+    assert lookup_cost(table, "features", 64) is None    # bucket never built
+    assert lookup_cost({}, "features", 8) is None
+    assert lookup_cost(None, "features", 8) is None
+
+
+# --------------------------------------------------- unit: budget admission
+
+
+def test_admission_registers_metrics_for_all_tenants_eagerly():
+    reg = MetricsRegistry()
+    AdmissionController(
+        parse_tenants("web=interactive:rate=5,bg=scavenger:budget=1"),
+        registry=reg,
+    )
+    text = reg.render()
+    # zero-valued children exist before any admit/shed event
+    assert 'serve_admit_total{tenant="web",class="interactive"} 0' in text
+    assert 'serve_admit_total{tenant="bg",class="scavenger"} 0' in text
+    for reason in ("quota", "pressure", "budget"):
+        assert (
+            f'serve_admit_shed_total{{tenant="bg",class="scavenger",'
+            f'reason="{reason}"}} 0' in text
+        )
+    assert (
+        'serve_tenant_budget_remaining{tenant="bg",class="scavenger"} 1'
+        in text
+    )
+
+
+def test_budget_exhaustion_degrades_to_scavenger_pressure():
+    reg = MetricsRegistry()
+    specs = parse_tenants("pay=batch:budget=1:window=60,free=batch")
+    meter = CostMeter(specs, registry=MetricsRegistry(), cost_fn=None)
+    pressure = {"v": 0.0}
+    adm = AdmissionController(
+        specs, meter=meter, registry=reg, pressure_fn=lambda: pressure["v"]
+    )
+    # under budget: admitted at any sub-class pressure
+    assert adm.admit("pay").name == "pay"
+    # spend past the budget
+    meter.observe_batch(
+        run_s=1.5, traces=[_trace(0, "pay")], batch=1
+    )
+    # over budget + zero pressure: still admitted (budgets don't hard-kill)
+    assert adm.admit("pay").name == "pay"
+    # over budget + scavenger-level pressure: typed budget shed...
+    pressure["v"] = 0.6
+    with pytest.raises(TenantBudgetError):
+        adm.admit("pay")
+    # ...while an unbudgeted batch-class tenant at the same pressure passes
+    assert adm.admit("free").name == "free"
+    assert adm.stats()["shed"] == {"pay:budget": 1}
+    text = reg.render()
+    assert (
+        'serve_admit_shed_total{tenant="pay",class="batch",reason="budget"} 1'
+        in text
+    )
+    assert (
+        'serve_tenant_budget_remaining{tenant="pay",class="batch"} 0' in text
+    )
+    # window rolls -> budget restored (fresh meter models the rolled window)
+    adm.set_meter(CostMeter(specs, registry=MetricsRegistry(), cost_fn=None))
+    assert adm.admit("pay").name == "pay"
+
+
+def test_parse_tenants_budget_grammar_and_errors():
+    ts = parse_tenants("pay=batch:rate=5:budget=2.5:window=30")
+    assert ts[0].budget == 2.5 and ts[0].budget_window_s == 30.0
+    assert ts[0].rate == 5.0
+    # defaults stay None so existing positional equality holds
+    assert parse_tenants("a=batch")[0].budget is None
+    with pytest.raises(ValueError, match="unknown tenant option"):
+        parse_tenants("a=batch:budgit=2")
+    with pytest.raises(ValueError, match="budget must be > 0"):
+        parse_tenants("a=batch:budget=0")
+    with pytest.raises(ValueError, match="window must be > 0"):
+        parse_tenants("a=batch:budget=1:window=-5")
+
+
+def test_scheduler_stamps_budget_shed_reason_in_access_row(tmp_path):
+    reg = MetricsRegistry()
+    log = AccessLog(tmp_path / "access")
+    tracer = RequestTracer(registry=reg, access_log=log)
+    specs = parse_tenants("pay=batch:budget=0.1:window=60")
+    meter = CostMeter(specs, registry=reg, cost_fn=None)
+    meter.observe_batch(run_s=1.0, traces=[_trace(0, "pay")], batch=1)
+    adm = AdmissionController(
+        specs, meter=meter, registry=reg, pressure_fn=lambda: 0.6
+    )
+
+    def dispatch(batch):  # never reached: the submit sheds
+        raise AssertionError("budget shed must happen at admission")
+
+    sched = ContinuousScheduler(
+        dispatch, max_batch=4, max_delay_ms=5.0, admission=adm,
+        tracer=tracer, registry=reg,
+    )
+    try:
+        with pytest.raises(TenantBudgetError):
+            sched.submit(_img(), tenant="pay")
+    finally:
+        sched.close()
+        tracer.close()
+    rows = [
+        r for r in read_journal(tmp_path / "access")
+        if r.get("type") == "request"
+    ]
+    assert len(rows) == 1
+    assert rows[0]["outcome"] == "shed"
+    assert rows[0]["err"] == "TenantBudgetError"
+
+
+# ------------------------------------- end to end: conservation through serve
+
+
+def _assert_conserved(meter, snap):
+    """Ledger totals must reconcile with the recorded batch measurements
+    within 1% (acceptance criterion), and per-tenant sums with the ledger
+    totals to float precision."""
+    measured_s = sum(s for s, _ in meter.observed)
+    per_tenant_s = sum(b["device_s"] for b in snap["tenants"].values())
+    per_tenant_f = sum(b["flops"] for b in snap["tenants"].values())
+    assert snap["total_batches"] == len(meter.observed)
+    assert per_tenant_s == pytest.approx(snap["total_device_s"], rel=1e-9)
+    assert per_tenant_f == pytest.approx(snap["total_flops"], rel=1e-9)
+    assert per_tenant_s == pytest.approx(measured_s, rel=0.01)
+
+
+def test_cost_conservation_across_dispatch_paths(tmp_path):
+    """Aligned full batches, bucket-aligned partial dispatch, and the
+    priority queue-jump all land in the meter, and the ledgers reconcile
+    with the per-batch wall-times."""
+    reg = MetricsRegistry()
+    log = AccessLog(tmp_path / "access")
+    tracer = RequestTracer(registry=reg, access_log=log)
+    specs = parse_tenants("vip=interactive,fill=scavenger")
+    meter = RecordingMeter(
+        specs,
+        registry=reg,
+        cost_fn=lambda eng, task, bucket: {"flops": bucket * 1e6},
+    )
+
+    def run(eng, batch, metas):
+        time.sleep(0.003)
+        return run_echo(eng, batch, metas)
+
+    rs = make_pool(
+        reg, tracer, replicas=2, run=run, max_batch=8, costmeter=meter
+    )
+    adm = AdmissionController(specs, registry=reg)
+    sched = ContinuousScheduler(
+        rs.submit_group, max_batch=8, max_delay_ms=10.0, admission=adm,
+        tracer=tracer, registry=reg,
+    )
+    futs = []
+    try:
+        # aligned: one full batch of 8
+        futs += [sched.submit(_img(i), tenant="fill") for i in range(8)]
+        wait(futs, timeout=10)
+        # partial: 3 due entries dispatch bucket-aligned
+        futs += [sched.submit(_img(i), tenant="vip") for i in range(3)]
+        wait(futs, timeout=10)
+    finally:
+        sched.close()
+    # priority jump: a small gated scheduler whose accumulator overfills
+    # while the dispatcher is blocked, so the vips jump the queue
+    gate = threading.Event()
+
+    def gated_dispatch(group):
+        gate.wait(5.0)
+        return rs.submit_group(group)
+
+    sched2 = ContinuousScheduler(
+        gated_dispatch, max_batch=2, max_delay_ms=5.0, admission=adm,
+        tracer=tracer, registry=reg,
+    )
+    try:
+        blockers = [sched2.submit(_img(0), tenant="fill") for _ in range(2)]
+        time.sleep(0.05)
+        late = [sched2.submit(_img(1), tenant="fill") for _ in range(2)]
+        time.sleep(0.02)
+        vips = [sched2.submit(_img(2), tenant="vip") for _ in range(2)]
+        gate.set()
+        futs += blockers + late + vips
+        done, not_done = wait(futs, timeout=20)
+        assert not not_done
+    finally:
+        sched2.close()
+        rs.close()
+        meter.flush()
+        tracer.close()
+    jumps = reg.snapshot()["serve_sched_priority_jumps_total"][""]
+    assert jumps >= 2
+    snap = meter.snapshot()
+    _assert_conserved(meter, snap)
+    # both tenants billed, all ok rows stamped
+    assert snap["tenants"]["vip"]["device_s"] > 0
+    assert snap["tenants"]["fill"]["device_s"] > 0
+    rows = [
+        r for r in read_journal(tmp_path / "access")
+        if r.get("type") == "request" and r["outcome"] == "ok"
+    ]
+    assert rows
+    assert all(r.get("device_ms", 0) > 0 for r in rows)
+    assert all(r.get("cost_flops", 0) > 0 for r in rows)
+    # per-tenant row sums reconcile with the ledger (every row traced)
+    row_s = sum(r["device_ms"] for r in rows) / 1000.0
+    assert row_s == pytest.approx(snap["total_device_s"], rel=0.01)
+
+
+def test_cost_conservation_under_replica_crash_faults(tmp_path, fault_plan):
+    """Acceptance: with serve.replica crash faults active, every ok row
+    still carries nonzero device_ms/cost_flops and the ledgers reconcile
+    within 1% — crashed batches are requeued, not billed."""
+    fault_plan("serve.replica:raise(RuntimeError)@key~r1")
+    reg = MetricsRegistry()
+    log = AccessLog(tmp_path / "access")
+    tracer = RequestTracer(registry=reg, access_log=log)
+    specs = parse_tenants("vip=interactive,crawl=batch")
+    meter = RecordingMeter(
+        specs,
+        registry=reg,
+        cost_fn=lambda eng, task, bucket: {"flops": bucket * 1e6},
+    )
+
+    def run(eng, batch, metas):
+        time.sleep(0.002)
+        return run_echo(eng, batch, metas)
+
+    rs = make_pool(
+        reg, tracer, replicas=3, run=run, max_queue=None, costmeter=meter
+    )
+    adm = AdmissionController(specs, registry=reg)
+    sched = ContinuousScheduler(
+        rs.submit_group, max_batch=8, max_delay_ms=2.0, max_queue=None,
+        admission=adm, tracer=tracer, registry=reg,
+    )
+    futs = []
+    try:
+        for i in range(60):
+            futs.append(
+                sched.submit(_img(i), tenant=("vip", "crawl")[i % 2])
+            )
+            if i % 7 == 0:
+                time.sleep(0.004)  # vary batch sizes across buckets
+        done, not_done = wait(futs, timeout=60)
+        assert not not_done
+    finally:
+        sched.close()
+        rs.close()
+        meter.flush()
+        tracer.close()
+    ok = [f for f in futs if f.exception() is None]
+    assert ok, "survivors must absorb the crash storm"
+    snap = meter.snapshot()
+    _assert_conserved(meter, snap)
+    rows = [
+        r for r in read_journal(tmp_path / "access")
+        if r.get("type") == "request"
+    ]
+    ok_rows = [r for r in rows if r["outcome"] == "ok"]
+    assert len(ok_rows) == len(ok)
+    assert all(r.get("device_ms", 0) > 0 for r in ok_rows)
+    assert all(r.get("cost_flops", 0) > 0 for r in ok_rows)
+    # requeued-off-r1 requests were billed once, on the surviving replica
+    assert all(r.get("replica") != "r1" for r in ok_rows)

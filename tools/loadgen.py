@@ -152,7 +152,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--tenants",
         default="web=interactive,scrape=batch",
-        help="name=class[:rate=N][:burst=N],... (serve/admission.py spec)",
+        help="name=class[:rate=N][:burst=N][:budget=D][:window=W],... "
+        "(serve/admission.py spec; budget = device-seconds per window)",
     )
     p.add_argument(
         "--mix", default="", help="tenant arrival shares, e.g. web=0.7,scrape=0.3"
@@ -192,9 +193,22 @@ def build_parser() -> argparse.ArgumentParser:
         default=1.5,
         help="modeled per-bucket-row cost (padded rows pay too)",
     )
+    p.add_argument(
+        "--model-gflops-per-item",
+        type=float,
+        default=1.0,
+        help="modeled executable cost: GFLOPs per bucket row (the cost "
+        "meter's analytic basis when no real engine is attached)",
+    )
     p.add_argument("--config", default="", help="YAML recipe: use a real engine")
     p.add_argument("--task", default="features")
     p.add_argument("--access-log", default="", metavar="DIR")
+    p.add_argument(
+        "--metrics-out",
+        default="",
+        metavar="PATH",
+        help="dump the final Prometheus scrape (registry render) here",
+    )
     p.add_argument(
         "--bench-history",
         default=None,
@@ -220,6 +234,8 @@ def main(argv: list[str] | None = None) -> dict:
         AdmissionController,
         Autoscaler,
         ContinuousScheduler,
+        CostMeter,
+        default_cost_fn,
         parse_tenants,
     )
 
@@ -270,6 +286,8 @@ def main(argv: list[str] | None = None) -> dict:
         def breakdown(engine):
             return engine.last_breakdown()
 
+        cost_fn = default_cost_fn  # real executables publish cost_reports
+
         probe_engine = provider(0)
         size = probe_engine.image_size
         image = (
@@ -292,6 +310,12 @@ def main(argv: list[str] | None = None) -> dict:
         def breakdown(engine):
             return engine.breakdown()
 
+        flops_per_row = args.model_gflops_per_item * 1e9
+
+        def cost_fn(engine, task, bucket):
+            # the modeled executable: every bucket row costs the same
+            return {"flops": bucket * flops_per_row}
+
         image = np.ones((8, 8), dtype=np.float32)
 
         def capacity_fn():
@@ -306,6 +330,7 @@ def main(argv: list[str] | None = None) -> dict:
     pool_queue = args.max_queue
     if args.scheduler == "continuous" and pool_queue is not None:
         pool_queue = pool_queue + 2 * args.max_batch
+    meter = CostMeter(tenants, cost_fn=cost_fn, tracer=tracer)
     rs = ReplicaSet(
         provider,
         run,
@@ -316,8 +341,9 @@ def main(argv: list[str] | None = None) -> dict:
         tracer=tracer,
         task=args.task,
         breakdown=breakdown,
+        costmeter=meter,
     )
-    admission = AdmissionController(tenants)
+    admission = AdmissionController(tenants, meter=meter)
     sched = None
     if args.scheduler == "continuous":
         sched = ContinuousScheduler(
@@ -393,6 +419,7 @@ def main(argv: list[str] | None = None) -> dict:
     if sched is not None:
         sched.close()
     rs.close()
+    meter.flush()  # final tenant_usage rows before the log closes
     tracer.close()
 
     # ------------------------------------------------------------- report
@@ -432,6 +459,20 @@ def main(argv: list[str] | None = None) -> dict:
             if lats
             else None
         )
+    cost = meter.snapshot()
+    for name, bill in cost["tenants"].items():
+        t = per_tenant.setdefault(
+            name,
+            {"class": bill["class"], "requests": 0, "ok": 0, "shed": 0,
+             "p50_ms": None, "p99_ms": None},
+        )
+        t["device_s"] = round(bill["device_s"], 4)
+        t["flops"] = bill["flops"]
+        t["waste_device_s"] = round(bill["waste_device_s"], 4)
+        t["cost_share"] = round(bill["share"], 4)
+        if "budget_device_s" in bill:
+            t["budget_device_s"] = bill["budget_device_s"]
+            t["over_budget"] = bill["over_budget"]
 
     all_lat = sorted(
         r["lat_ms"] for r in req_rows if r["outcome"] == "ok"
@@ -479,6 +520,12 @@ def main(argv: list[str] | None = None) -> dict:
         "batches": len(flush_sizes),
         "size_hist": {k: size_hist[k] for k in sorted(size_hist)},
         "tenants": per_tenant,
+        "cost": {
+            "total_batches": cost["total_batches"],
+            "total_device_s": round(cost["total_device_s"], 4),
+            "total_flops": cost["total_flops"],
+            "chip": cost.get("chip"),
+        },
         "admission": admission.stats(),
         "autoscale_events": (
             list(autoscaler.events) if autoscaler is not None else []
@@ -490,23 +537,40 @@ def main(argv: list[str] | None = None) -> dict:
         f"[loadgen] ok={ok} shed_at_submit={shed} "
         f"failed={failed} occ={result['occupancy_mean']} "
         f"pad={result['pad_mean']} p99={result['p99_ms']}ms "
+        f"device_s={result['cost']['total_device_s']} "
         f"autoscale_events={len(result['autoscale_events'])}"
     )
 
     history = resolve_history_path(args.bench_history)
     if history is not None and ok:
+        total_dev_s = cost["total_device_s"]
+        legs = {
+            "req_per_sec": result["req_per_sec"],
+            "p50_ms": result["p50_ms"],
+            "p99_ms": result["p99_ms"],
+            "occupancy_mean": result["occupancy_mean"],
+            # cost efficiency: work delivered per metered device-second —
+            # perf_doctor gates this next to throughput
+            "device_s_total": round(total_dev_s, 4),
+            "ok_per_device_s": (
+                round(ok / total_dev_s, 2) if total_dev_s > 0 else 0.0
+            ),
+        }
+        for name, bill in cost["tenants"].items():
+            legs[f"device_s_{name}"] = round(bill["device_s"], 4)
         row = make_row(
             bench="serve",
             metric=f"loadgen_{args.profile}_{args.scheduler}",
-            legs={
-                "req_per_sec": result["req_per_sec"],
-                "p50_ms": result["p50_ms"],
-                "p99_ms": result["p99_ms"],
-                "occupancy_mean": result["occupancy_mean"],
-            },
+            legs=legs,
             quantiles={"p50_ms": result["p50_ms"], "p99_ms": result["p99_ms"]},
             extra={
                 "pad_mean": result["pad_mean"],
+                "waste_device_s": round(
+                    sum(
+                        b["waste_device_s"] for b in cost["tenants"].values()
+                    ),
+                    4,
+                ),
                 "profile": args.profile,
                 "scheduler": args.scheduler,
                 "seed": args.seed,
@@ -514,6 +578,14 @@ def main(argv: list[str] | None = None) -> dict:
         )
         if append_row(history, row):
             print(f"[loadgen] ledger row -> {history}")
+
+    if args.metrics_out:
+        from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+        mpath = Path(args.metrics_out)
+        mpath.parent.mkdir(parents=True, exist_ok=True)
+        mpath.write_text(get_registry().render())
+        print(f"[loadgen] metrics -> {mpath}")
 
     if args.out:
         out = Path(args.out)

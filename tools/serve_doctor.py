@@ -25,10 +25,14 @@ requeue (``requeued_from``) and who absorbed the retries, and a pool
 event timeline — crashes, hangs, restarts, breaker flips, autoscale
 resizes, and weight-swap verdicts (a ``swap_rollback`` also lands in the
 Verdict line). Rows carrying ``tenant``/``class`` (the traffic-shaping
-tier) add a per-tenant table plus a shaping-vs-starvation verdict: low
-classes shedding first is the design working; a shed *interactive*
-tenant while lower classes kept being served is priority inversion and
-is called out as starvation.
+tier) add a per-tenant table — with device-seconds / FLOPs / pad-waste
+columns when the cost meter stamped ``device_ms``/``cost_flops`` onto the
+rows — plus a shaping-vs-starvation verdict: low classes shedding first
+is the design working; a shed *interactive* tenant while lower classes
+kept being served is priority inversion and is called out as starvation,
+and a tenant hogging device-time over its implied share while cheaper
+tenants shed is called out as a noisy neighbor (the full chargeback view
+lives in ``tools/cost_doctor.py``).
 
 Without ``--slo`` the slow-request threshold defaults to 4x the median ok
 latency — a shape-based heuristic for "what would have annoyed a caller",
@@ -309,11 +313,13 @@ def diagnose(
         lines += [
             "## Tenants",
             "",
-            "| tenant | class | requests | ok | shed | p50 ms | p99 ms |",
-            "|---|---|---|---|---|---|---|",
+            "| tenant | class | requests | ok | shed | device s | GFLOPs "
+            "| waste s | p50 ms | p99 ms |",
+            "|---|---|---|---|---|---|---|---|---|---|",
         ]
         shed_by_ten: dict[str, int] = {}
         class_of: dict[str, str] = {}
+        dev_by_ten: dict[str, float] = {}
         for name in sorted(by_ten):
             sel = by_ten[name]
             tclass = next(
@@ -326,8 +332,20 @@ def diagnose(
             )
             shed_n = sum(1 for r in sel if r["outcome"] == "shed")
             shed_by_ten[name] = shed_n
+            # cost columns from the meter-stamped device_ms/cost_flops;
+            # waste = device-time that bought pad rows (row share × pad)
+            dev_s = sum(r.get("device_ms") or 0.0 for r in sel) / 1000.0
+            gflops = sum(r.get("cost_flops") or 0.0 for r in sel) / 1e9
+            waste_s = sum(
+                (r.get("device_ms") or 0.0) * (r.get("pad") or 0.0)
+                for r in sel
+            ) / 1000.0
+            dev_by_ten[name] = dev_s
             lines.append(
                 f"| {name} | {tclass} | {len(sel)} | {len(oks)} | {shed_n} "
+                f"| {fmt_num(dev_s) if dev_s else '-'} "
+                f"| {fmt_num(gflops) if gflops else '-'} "
+                f"| {fmt_num(waste_s) if waste_s else '-'} "
                 f"| {fmt_num(_quantile(lat, 0.50)) if lat else '-'} "
                 f"| {fmt_num(_quantile(lat, 0.99)) if lat else '-'} |"
             )
@@ -347,6 +365,22 @@ def diagnose(
                 if o != t
             )
         ]
+        # noisy neighbor: a tenant well over its implied (equal) share of
+        # metered device-time while a cheaper tenant was shedding — the
+        # cost-accounting refinement of the starvation signal
+        noisy: list[str] = []
+        total_dev = sum(dev_by_ten.values())
+        if total_dev > 0 and len(dev_by_ten) > 1 and shed_tenants:
+            fair = 1.0 / len(dev_by_ten)
+            for name, dev_s in dev_by_ten.items():
+                share = dev_s / total_dev
+                if share <= 1.25 * fair:
+                    continue
+                if any(
+                    o != name and dev_by_ten[o] < dev_s
+                    for o in shed_tenants
+                ):
+                    noisy.append(name)
         if starved:
             verdict.append(
                 "**starvation**: interactive tenant(s) "
@@ -361,6 +395,16 @@ def diagnose(
                     for t in sorted(shed_tenants)
                 )
                 + " — low classes gave way first"
+            )
+        if noisy:
+            verdict.append(
+                "noisy neighbor: "
+                + ", ".join(
+                    f"`{t}` ({dev_by_ten[t] / total_dev * 100:.0f}% of "
+                    f"device-time)"
+                    for t in sorted(noisy)
+                )
+                + " over its implied share while cheaper tenants shed"
             )
 
     # ------------------------------------------------- non-ok rid clusters
