@@ -1,0 +1,39 @@
+"""Resumable, exactly-once offline batch inference over tar shards.
+
+Submodules:
+
+- ``job``      — :class:`JobSpec` / :class:`BatchJobRunner`, the
+  lease-fenced shard-parallel executor
+- ``leases``   — :class:`LeaseTable`, journaled leases with expiry/steal
+  and write fencing
+- ``partfile`` — framed torn-tail-tolerant part files and the
+  deterministic manifest
+"""
+
+from jumbo_mae_tpu_tpu.batch.job import (
+    BatchJobRunner,
+    JobSpec,
+    default_decode,
+    part_stem,
+)
+from jumbo_mae_tpu_tpu.batch.leases import LeaseTable
+from jumbo_mae_tpu_tpu.batch.partfile import (
+    file_sha256,
+    iter_records,
+    read_manifest,
+    scan_part,
+    write_manifest,
+)
+
+__all__ = [
+    "BatchJobRunner",
+    "JobSpec",
+    "LeaseTable",
+    "default_decode",
+    "file_sha256",
+    "iter_records",
+    "part_stem",
+    "read_manifest",
+    "scan_part",
+    "write_manifest",
+]

@@ -19,6 +19,11 @@ Plan grammar (rules separated by ``;``)::
             | 'nan'                            -- replace the value with NaN
     sel     = 'n=' A [ '..' B ]   -- rule-local invocation index (0-based,
                                      inclusive range)
+                                     -- counting selectors index into the
+                                     rule's *filtered* stream: invocations
+                                     rejected by 'key~'/'host=' don't
+                                     advance n, so 'key~r1,n<1' is exactly
+                                     "r1's first call"
             | 'n<' N              -- first N invocations
             | 'n%' K '=' R        -- every K-th invocation with remainder R
             | 'p=' F              -- seeded Bernoulli(F) per invocation
@@ -36,9 +41,11 @@ All selectors of a rule must match for it to fire. Examples::
     seed=7;data.decode:corrupt(4)@p=0.01          # 1% of decodes corrupted
     serve.replica:raise(RuntimeError)@key~r1,n<1  # crash replica r1's first batch
     serve.replica:delay(5.0)@key~r2               # wedge replica r2 (hang path)
+    serve.preempt:raise@n=1                       # preempt (drain) one replica
     ckpt.load:corrupt(4)                          # diverge a hot-swap restore
     data.decode:delay(0.2)@host=1                 # straggle host 1 of a pod
     host.leak:corrupt(8)                          # leak 8 MB/step on the host
+    batch.worker:raise@n<1                        # kill a batch-job worker mid-shard
 
 The ``host=`` selector resolves the current process's host index lazily at
 fire time: an explicit :func:`set_host_index` (``cli/train.py`` pins it
@@ -48,8 +55,8 @@ else ``jax.process_index()`` when jax is already imported, else 0.
 
 Known sites (free-form names are allowed; these are the wired ones):
 ``data.shard_open``, ``data.decode``, ``train.loss``, ``train.grad``,
-``serve.submit``, ``serve.replica``, ``ckpt.save``, ``ckpt.load``,
-``host.leak``.
+``serve.submit``, ``serve.replica``, ``serve.preempt``, ``ckpt.save``,
+``ckpt.load``, ``host.leak``, ``batch.worker``.
 
 ``serve.replica`` fires at the top of each replica's batched predict with
 ``key`` = the replica name (``r0``, ``r1``, …), so ``key~`` targets one
@@ -58,7 +65,14 @@ is a hang. ``ckpt.load`` fires on the weight-swap restore path with the
 restored params tree as ``data`` — ``corrupt(k)`` sign-flips ``k``
 deterministically-chosen leaves so the parity gate sees a diverged model
 (a real bad-push, not a parse error), while ``raise`` models an unreadable
-checkpoint. ``host.leak`` is the memory-observability chaos site, ticked
+checkpoint. ``serve.preempt`` is ticked by the :class:`ReplicaSet` supervisor once per
+tick per routable replica (``key`` = replica name): a ``raise`` firing is a
+preemption notice — the replica *drains* (pause → idle → retire → restart)
+instead of dying with its queue, the graceful twin of ``serve.replica``'s
+crash. ``batch.worker`` fires in the offline batch runner's worker loop
+(``key`` = worker name): a ``raise`` kills that worker dead without
+releasing its shard lease — the lease-expiry/steal path another worker must
+recover. ``host.leak`` is the memory-observability chaos site, ticked
 once per train step: ``corrupt(n)`` retains ``n`` MB in a module-level
 ballast list each time it fires (a controllable host leak the
 ``LeakSentinel`` must catch and attribute), ``raise`` clears the ballast
@@ -100,9 +114,11 @@ KNOWN_SITES = (
     "train.grad",
     "serve.submit",
     "serve.replica",
+    "serve.preempt",
     "ckpt.save",
     "ckpt.load",
     "host.leak",
+    "batch.worker",
 )
 
 
@@ -117,10 +133,27 @@ class FaultRule:
     action: str
     arg: str | float | None = None
     selectors: list[tuple[str, object]] = field(default_factory=list)
-    calls: int = 0  # invocations of the site seen by THIS rule
+    calls: int = 0  # invocations that passed this rule's identity filters
     hits: int = 0   # invocations this rule actually fired on
 
-    def matches(self, key: str | None, rng) -> bool:
+    def filter_matches(self, key: str | None) -> bool:
+        """Identity selectors (``key~``, ``host=``): does this invocation
+        belong to the stream the rule targets at all? Invocations that fail
+        here are invisible to the rule — they do not advance ``calls`` — so
+        ``key~r1,n<1`` means "r1's first call", not "the first call overall,
+        if it happens to be r1's" (which would race against other keys)."""
+        for kind, val in self.selectors:
+            if kind == "key~":
+                if key is None or val not in str(key):
+                    return False
+            elif kind == "host=":
+                if current_host_index() != val:
+                    return False
+        return True
+
+    def gate_matches(self, rng) -> bool:
+        """Counting selectors (``n=``/``n<``/``n%``/``p=``), evaluated
+        against the filtered invocation index."""
         n = self.calls
         for kind, val in self.selectors:
             if kind == "n=":
@@ -135,15 +168,8 @@ class FaultRule:
                 if n % k != r:
                     return False
             elif kind == "p=":
-                # one seeded draw per invocation, keyed on (rule, n) so the
-                # outcome is independent of call interleaving across sites
+                # one seeded draw per filtered invocation
                 if rng.random() >= val:
-                    return False
-            elif kind == "key~":
-                if key is None or val not in str(key):
-                    return False
-            elif kind == "host=":
-                if current_host_index() != val:
                     return False
         return True
 
@@ -257,7 +283,13 @@ class FaultPlan:
         with self._lock:
             fired = None
             for r in rules:
-                if fired is None and r.matches(key, self._rng):
+                # identity filters gate the counter too: a rule only "sees"
+                # invocations from its own key/host stream, so n-selectors
+                # index into that stream deterministically regardless of how
+                # other keys interleave with it
+                if not r.filter_matches(key):
+                    continue
+                if fired is None and r.gate_matches(self._rng):
                     fired = r
                     r.hits += 1
                 r.calls += 1

@@ -247,6 +247,13 @@ class ReplicaSet:
             "attributed to the replica that failed them",
             labels=("replica",),
         )
+        self._m_preempted = reg.counter(
+            "serve_replica_preempted_total",
+            "replicas drained and retired by preemption notice "
+            "(pause -> idle -> down -> supervisor restart); never drops "
+            "in-flight work",
+            labels=("replica",),
+        )
         self._m_healthy = reg.gauge(
             "serve_replica_healthy_count", "replicas currently up or paused"
         )
@@ -323,6 +330,9 @@ class ReplicaSet:
             self._slots.append(rep)
             self._start_worker(rep)
             self._m_up.labels(rep.name).set(1)
+            # eager child: the preemption counter scrapes as 0 from boot,
+            # not from the first preemption (PR 15 registration pattern)
+            self._m_preempted.labels(rep.name)
             if self._health is not None:
                 self._health.beat(f"replica.{rep.name}")
         self._update_health()
@@ -666,6 +676,46 @@ class ReplicaSet:
         rep.engine = None  # drop the engine's memory with the slot
         return rep.name
 
+    def preempt(self, idx: int, *, drain_timeout_s: float = 10.0) -> bool:
+        """Preemption notice for replica ``idx`` (TPU maintenance, spot
+        reclaim, or the ``serve.preempt`` fault site): take it out of
+        routing, let it finish everything it already holds, then retire
+        the incarnation — zero in-flight requests dropped. The slot goes
+        ``down`` WITHOUT a failure count (preemption is not a crash), so
+        the supervisor restarts it after one plain backoff and the
+        capacity returns. False when the slot is not preemptible right
+        now (down/restarting/closed) or the drain timed out (routing is
+        restored and the caller may retry)."""
+        with self._state_lock:
+            if self._closed or idx >= len(self._slots):
+                return False
+            rep = self._slots[idx]
+            if rep.state not in ("up", "paused") or self._restarting[idx]:
+                return False
+            we_paused = rep.state == "up"
+            rep.state = "paused"  # out of routing; drains what it holds
+        if not self.wait_idle(idx, drain_timeout_s):
+            with self._state_lock:
+                if we_paused and not self._stale(rep) and rep.state == "paused":
+                    rep.state = "up"
+            return False
+        with self._state_lock:
+            # re-verify: a crash/hang during the drain means this
+            # incarnation is no longer ours to retire
+            if self._stale(rep) or rep.state != "paused":
+                return False
+            rep.state = "down"
+            self._m_up.labels(rep.name).set(0)
+            # plain backoff, no fails increment: the replacement should
+            # come back at base speed, not on the crash penalty curve
+            self._restart_at[idx] = self._clock() + self.restart_backoff_s
+            self._update_health()
+        self._m_preempted.labels(rep.name).inc()
+        self._event("replica_preempted", replica=rep.name, gen=rep.gen)
+        rep.q.put(_STOP)
+        self._drain_slot(rep, "replica preempted")
+        return True
+
     def pressure(self) -> float:
         """Pending depth / max_queue in [0, ~] — cheap enough to call per
         admission decision (one counter read, no slot snapshot). Unbounded
@@ -713,7 +763,12 @@ class ReplicaSet:
         if self._closed:
             return
         self._drain = drain
-        self._closed = True
+        # latch shutdown under the state lock: _restart_slot re-checks
+        # _closed under the same lock before installing a new incarnation,
+        # so a restart that raced close() can never respawn a slot after
+        # the close sweep has run
+        with self._state_lock:
+            self._closed = True
         self._supervisor.join(timeout=max(1.0, self._interval * 4))
         for rep in self._slots:
             rep.q.put(_STOP)
@@ -1051,6 +1106,18 @@ class ReplicaSet:
                 self._drain_slot(rep, "replica removed")
             for rep in list(self._slots):
                 if rep.state in ("up", "paused"):
+                    try:
+                        # preemption notice: ticked once per routable
+                        # replica per supervisor pass (key = replica name)
+                        fault_point("serve.preempt", key=rep.name)
+                    except Exception:
+                        # drain in a thread — a 10s drain must not stall
+                        # hang detection for every other replica
+                        threading.Thread(
+                            target=self.preempt, args=(rep.idx,),
+                            daemon=True, name=f"replica-preempt-{rep.name}",
+                        ).start()
+                        continue
                     busy = rep.busy_since
                     if busy is not None and now - busy > self.hang_timeout_s:
                         # hung predict: abandon the thread, rescue the work
@@ -1102,6 +1169,12 @@ class ReplicaSet:
                 return
             rep = _Replica(idx, gen=old.gen + 1, engine=engine)
             with self._state_lock:
+                # the shutdown latch: close() sets _closed under this lock
+                # before sweeping, so checking here (not just above, where
+                # the slow provider build races close) guarantees a new
+                # incarnation is never installed after close began
+                if self._closed:
+                    return
                 self._slots[idx] = rep
             self._start_worker(rep)
             self._m_up.labels(rep.name).set(1)

@@ -49,6 +49,8 @@ import time
 import uuid
 from pathlib import Path
 
+from jumbo_mae_tpu_tpu.obs.journal import fsync_dir
+
 # format version is part of MAGIC: bump it and every older entry misses
 # cleanly (no attempt to parse an incompatible layout)
 MAGIC = b"JWC1"
@@ -191,6 +193,7 @@ class WarmCache:
             )
             tmp.write_bytes(blob)
             os.replace(tmp, path)
+            fsync_dir(self.root)  # rename alone is not durable over power loss
         except Exception as e:  # noqa: BLE001
             if tmp is not None:
                 Path(tmp).unlink(missing_ok=True)
@@ -214,6 +217,7 @@ class WarmCache:
         try:
             tmp.write_text(json.dumps(meta, sort_keys=True, default=str))
             os.replace(tmp, path)
+            fsync_dir(self.root)
         except Exception:  # noqa: BLE001
             Path(tmp).unlink(missing_ok=True)
 
@@ -231,6 +235,10 @@ class WarmCache:
         try:
             qdir.mkdir(exist_ok=True)
             os.replace(path, dst)
+            # both directories changed; sync both or a crash can resurrect
+            # the corrupt entry under its servable name
+            fsync_dir(qdir)
+            fsync_dir(self.root)
         except OSError:
             path.unlink(missing_ok=True)
         self.quarantined += 1

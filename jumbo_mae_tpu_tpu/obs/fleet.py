@@ -171,6 +171,10 @@ class HostBeacon:
             payload["device_peak_bytes"] = int(device_peak_bytes)
         payload.update(extra)
         self._tmp.write_text(json.dumps(payload, separators=(",", ":")))
+        # deliberately NOT fsync_dir'd (unlike journal/ckpt/warmcache
+        # commits): beacons are per-step advisory liveness data rewritten
+        # every few seconds — losing one to power loss costs a single
+        # staleness window, while an fsync here would tax every step
         os.replace(self._tmp, self.path)
         self.writes += 1
         return payload

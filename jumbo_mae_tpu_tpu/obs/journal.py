@@ -80,9 +80,35 @@ JOURNAL_EVENTS = frozenset(
         "autoscale",
         "replica_added",
         "replica_removed",
+        "replica_preempted",
         "tenant_usage",
+        "job_start",
+        "job_lease",
+        "job_cursor",
+        "job_shard_done",
+        "job_complete",
     }
 )
+
+
+def fsync_dir(path: "str | Path") -> None:
+    """fsync a directory so a just-renamed (or just-created) entry survives
+    power loss — ``os.replace`` alone only orders the rename against other
+    operations on the *file*; the new directory entry itself is volatile
+    until the parent directory's metadata reaches disk. Best-effort: on
+    filesystems/platforms that refuse directory fds the rename still
+    happened, we just lose the power-loss guarantee we never had before.
+    """
+    try:
+        fd = os.open(str(path), os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - e.g. fsync on FAT/network mounts
+        pass
+    finally:
+        os.close(fd)
 
 
 def _json_default(obj):
@@ -146,6 +172,10 @@ class RunJournal:
         # filename stays total
         self._index = self._next_index()
         self._file = open(self._segment_path(self._index), "a", encoding="utf-8")
+        if self.fsync:
+            # the segment's directory entry must be durable too: fsync'd
+            # lines inside a file whose name was lost to power loss are gone
+            fsync_dir(self.directory)
 
     def _next_index(self) -> int:
         existing = sorted(self.directory.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}"))
@@ -192,6 +222,8 @@ class RunJournal:
         self._file.close()
         self._index += 1
         self._file = open(self._segment_path(self._index), "a", encoding="utf-8")
+        if self.fsync:
+            fsync_dir(self.directory)
         # prune the oldest segments beyond the retention budget
         segments = sorted(self.directory.glob(f"{_SEGMENT_PREFIX}*{_SEGMENT_SUFFIX}"))
         for old in segments[: max(0, len(segments) - self.keep)]:

@@ -305,6 +305,25 @@ class AdmissionController:
             self._admitted_n[sp.name] = self._admitted_n.get(sp.name, 0) + 1
         return sp
 
+    def admit_wait(
+        self, tenant: str | None, timeout_s: float = 30.0
+    ) -> TenantSpec:
+        """Blocking :meth:`admit` for throughput-class clients (batch
+        jobs): a shed is backpressure, not an answer, so retry with
+        backoff until admitted or ``timeout_s`` passes — then re-raise
+        the last typed shed for the caller's error accounting. Never use
+        this on an interactive path (it holds the calling thread)."""
+        deadline = self._clock() + timeout_s
+        delay = 0.02
+        while True:
+            try:
+                return self.admit(tenant)
+            except QueueFullError:
+                if self._clock() >= deadline:
+                    raise
+                time.sleep(min(delay, max(0.0, deadline - self._clock())))
+                delay = min(delay * 2, 0.5)
+
     def _shed(self, sp: TenantSpec, reason: str) -> None:
         self._m_shed.labels(sp.name, sp.tclass, reason).inc()
         with self._lock:

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import os
 import threading
 from dataclasses import dataclass
 from pathlib import Path
@@ -38,6 +39,7 @@ import orbax.checkpoint as ocp
 from flax import serialization
 
 from jumbo_mae_tpu_tpu.data.tario import open_url
+from jumbo_mae_tpu_tpu.obs.journal import fsync_dir
 
 
 def is_remote_path(path) -> bool:
@@ -870,7 +872,13 @@ def export_params_msgpack(params, path: str, *, background: bool = False):
         target.parent.mkdir(parents=True, exist_ok=True)
         tmp = target.with_suffix(target.suffix + ".tmp")
         tmp.write_bytes(payload)
+        fd = os.open(str(tmp), os.O_RDONLY)
+        try:
+            os.fsync(fd)  # data durable before the rename can expose it
+        finally:
+            os.close(fd)
         tmp.replace(target)  # atomic: readers never see a partial file
+        fsync_dir(target.parent)  # rename durable over power loss
 
     if background:
         t = threading.Thread(target=write, daemon=False)

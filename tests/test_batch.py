@@ -1,0 +1,423 @@
+"""Resumable offline batch inference contracts (batch/ + cli/batch.py).
+
+The exactly-once story this suite proves, each property in isolation and
+then end-to-end under injected and real (SIGKILL) faults:
+
+- **part files are torn-tail-tolerant**: a frame cut anywhere scans back
+  to the durable prefix, and the prefix is the resume cursor;
+- **leases expire and steal**: a worker that dies mid-shard stops
+  renewing; a survivor steals the shard (journaled with ``stolen_from``)
+  and the fencing token keeps a slow zombie from ever writing again;
+- **byte-identical output**: a job killed by the ``batch.worker`` fault,
+  a torn partial, a graceful preemption stop, or a SIGKILL'd process
+  produces — after resume — a manifest byte-identical to a fault-free
+  control run (no sample dropped, duplicated, or reordered);
+- **quarantined shards don't wedge the job**: the store giving up on a
+  shard excludes it from the manifest and the job still completes;
+- **the doctor is honest**: exit 0 only when the manifest reconciles
+  100% against the bytes on disk, exit 2 on corruption/orphans/absence,
+  and its report names the worker a stolen lease was rescued from.
+"""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu import faults
+from jumbo_mae_tpu_tpu.batch import (
+    BatchJobRunner,
+    JobSpec,
+    LeaseTable,
+    part_stem,
+    read_manifest,
+    scan_part,
+)
+from jumbo_mae_tpu_tpu.batch.partfile import (
+    MAGIC,
+    append_record,
+    encode_record,
+    iter_records,
+)
+from jumbo_mae_tpu_tpu.data.tario import QUARANTINE, RetryPolicy, write_tar_samples
+from jumbo_mae_tpu_tpu.obs.journal import fsync_dir, read_journal
+from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+from jumbo_mae_tpu_tpu.serve.admission import (
+    AdmissionController,
+    TenantPressureError,
+    parse_tenants,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def fault_plan():
+    yield faults.install_plan
+    faults.clear_plan()
+    QUARANTINE.clear()
+
+
+# ----------------------------------------------------------- stub harness
+
+
+def stub_submit(image, *, task=None, deadline_ms=None, meta=None, tenant=None):
+    """Deterministic ContinuousScheduler.submit stand-in: the result
+    depends only on the input bytes (the byte-identity tests need it)."""
+    f = Future()
+    f.set_result({"sum": int(image.astype(np.int64).sum())})
+    return f
+
+
+def make_shards(root: Path, n_shards=3, n_samples=8) -> list[str]:
+    urls = []
+    for i in range(n_shards):
+        url = str(root / f"shard{i}.tar")
+        write_tar_samples(
+            url,
+            [
+                {"__key__": f"s{i}-{j}", "bin": bytes([i, j] * 16)}
+                for j in range(n_samples)
+            ],
+        )
+        urls.append(url)
+    return urls
+
+
+def run_job(shards, out, **kw) -> tuple[dict, BatchJobRunner]:
+    spec_kw = dict(workers=2, submit_window=3, lease_s=0.3)
+    spec_kw.update(kw)
+    spec = JobSpec(shards=tuple(shards), output_dir=str(out), **spec_kw)
+    runner = BatchJobRunner(spec, stub_submit, registry=MetricsRegistry())
+    return runner.run(), runner
+
+
+# -------------------------------------------------------------- partfile
+
+
+class TestPartFile:
+    def test_scan_truncates_torn_tail_not_prefix(self, tmp_path):
+        p = tmp_path / "x.partial"
+        with open(p, "wb") as f:
+            for i in range(5):
+                append_record(f, encode_record(f"k{i}", {"v": i}))
+        whole = p.stat().st_size
+        n, good = scan_part(p)
+        assert (n, good) == (5, whole)
+        # tear the last frame mid-payload: prefix survives exactly
+        with open(p, "r+b") as f:
+            f.truncate(whole - 3)
+        n, good = scan_part(p)
+        assert n == 4
+        assert [r["key"] for r in iter_records(p)][:4] == ["k0", "k1", "k2", "k3"]
+        # corrupt a payload byte (digest mismatch): scan stops there
+        data = bytearray(p.read_bytes())
+        data[good - 2] ^= 0xFF
+        p.write_bytes(bytes(data))
+        assert scan_part(p)[0] == 3
+
+    def test_bad_magic_stops_scan(self, tmp_path):
+        p = tmp_path / "x.partial"
+        with open(p, "wb") as f:
+            append_record(f, encode_record("k", {"v": 1}))
+            f.write(b"GARBAGEGARBAGE")
+        assert scan_part(p)[0] == 1
+        assert MAGIC == b"JMB1" and struct.calcsize("<4sI8s") == 16
+
+    def test_encode_is_deterministic_and_numpy_safe(self):
+        out = {"b": np.float32(1.5), "a": np.arange(3), "flag": np.bool_(True)}
+        assert encode_record("k", out) == encode_record("k", dict(reversed(out.items())))
+
+
+# ---------------------------------------------------------------- leases
+
+
+class TestLeaseTable:
+    def test_claim_order_renew_complete(self):
+        t = LeaseTable(["a", "b"], lease_s=10.0)
+        s1, l1 = t.claim("w0")
+        assert s1 == "a" and t.holds("a", "w0", l1)
+        assert t.renew("a", "w0", l1)
+        assert t.claim("w1") == ("b", 2)
+        assert t.complete("a", "w0", l1)
+        assert t.counts() == {"pending": 0, "leased": 1, "done": 1}
+
+    def test_expiry_steal_fences_old_holder(self, tmp_path):
+        now = [0.0]
+        journal_events = []
+
+        class J:
+            def event(self, etype, **f):
+                journal_events.append({"type": etype, **f})
+
+        t = LeaseTable(["a"], lease_s=1.0, clock=lambda: now[0], journal=J())
+        _, l1 = t.claim("w0")
+        assert t.claim("w1") is None  # still held
+        now[0] = 2.0  # past expiry
+        s2, l2 = t.claim("w1")
+        assert (s2, t.steals) == ("a", 1)
+        # the zombie is fenced: holds/renew/complete all refuse it
+        assert not t.holds("a", "w0", l1)
+        assert not t.renew("a", "w0", l1)
+        assert not t.complete("a", "w0", l1)
+        assert t.complete("a", "w1", l2)
+        steal = [e for e in journal_events if e.get("stolen_from")]
+        assert steal and steal[0]["stolen_from"] == "w0"
+
+    def test_release_makes_claimable_immediately(self):
+        t = LeaseTable(["a"], lease_s=100.0)
+        _, l1 = t.claim("w0")
+        assert t.release("a", "w0", l1)
+        assert t.claim("w1") is not None
+
+
+# ------------------------------------------------------------- job runner
+
+
+def test_job_completes_and_rerun_is_noop(tmp_path):
+    shards = make_shards(tmp_path)
+    s, _ = run_job(shards, tmp_path / "out")
+    assert s["complete"] and s["total_samples"] == 24
+    m = read_manifest(tmp_path / "out" / "manifest.json")
+    assert [e["shard"] for e in m["shards"]] == shards  # spec order
+    s2, _ = run_job(shards, tmp_path / "out")
+    assert s2["already_complete"]
+    events = [e["type"] for e in read_journal(tmp_path / "out" / "journal")]
+    assert {"job_start", "job_lease", "job_shard_done", "job_complete"} <= set(events)
+
+
+def test_worker_killed_by_fault_steal_and_byte_identical(tmp_path, fault_plan):
+    """The tentpole proof: ``batch.worker`` kills w0 mid-shard WITHOUT a
+    lease release; w1 steals after expiry, resumes from the durable
+    partial, and the manifest is byte-identical to the fault-free run."""
+    shards = make_shards(tmp_path)
+    run_job(shards, tmp_path / "ctrl")
+    fault_plan("batch.worker:raise@key~w0,n<1")
+    s, _ = run_job(shards, tmp_path / "flt")
+    faults.clear_plan()
+    assert s["complete"] and s["lease_steals"] >= 1
+    a = (tmp_path / "ctrl" / "manifest.json").read_bytes()
+    b = (tmp_path / "flt" / "manifest.json").read_bytes()
+    assert a == b
+    leases = [
+        e for e in read_journal(tmp_path / "flt" / "journal")
+        if e["type"] == "job_lease" and e.get("stolen_from")
+    ]
+    assert leases and leases[0]["stolen_from"] == "w0"
+
+
+def test_torn_partial_resumes_byte_identical(tmp_path):
+    """Kill simulated at the filesystem: a .partial with a torn tail (the
+    exact artifact of SIGKILL mid-append) resumes to identical bytes."""
+    shards = make_shards(tmp_path, n_shards=1, n_samples=10)
+    run_job(shards, tmp_path / "ctrl", workers=1)
+    # build the torn state: run once, demote the part to a torn partial
+    run_job(shards, tmp_path / "flt", workers=1)
+    parts = tmp_path / "flt" / "parts"
+    part = next(parts.glob("*.part"))
+    partial = parts / (part.name[: -len(".part")] + ".partial")
+    part.rename(partial)
+    with open(partial, "r+b") as f:
+        f.truncate(partial.stat().st_size - 5)  # torn final frame
+    (tmp_path / "flt" / "manifest.json").unlink()
+    s, runner = run_job(shards, tmp_path / "flt", workers=1)
+    assert s["complete"]
+    assert (tmp_path / "ctrl" / "manifest.json").read_bytes() == (
+        tmp_path / "flt" / "manifest.json"
+    ).read_bytes()
+    # the resume skipped the durable prefix instead of recomputing it
+    assert runner._m_resumed.value >= 9
+
+
+def test_graceful_stop_resumes_to_identical_manifest(tmp_path):
+    """request_stop() (the SIGTERM path) mid-run: leases released, job
+    exits incomplete-but-resumable; the next run finishes byte-identically."""
+    shards = make_shards(tmp_path, n_shards=4, n_samples=12)
+    run_job(shards, tmp_path / "ctrl")
+
+    slow = threading.Event()
+
+    def slow_submit(image, **kw):
+        if not slow.is_set():
+            time.sleep(0.01)
+        return stub_submit(image, **kw)
+
+    spec = JobSpec(
+        shards=tuple(shards), output_dir=str(tmp_path / "flt"),
+        workers=1, submit_window=2, lease_s=5.0,
+    )
+    runner = BatchJobRunner(spec, slow_submit, registry=MetricsRegistry())
+    t = threading.Thread(target=runner.run)
+    t.start()
+    time.sleep(0.08)
+    runner.request_stop()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert read_manifest(tmp_path / "flt" / "manifest.json") is None
+    slow.set()
+    s, _ = run_job(shards, tmp_path / "flt")
+    assert s["complete"]
+    assert (tmp_path / "ctrl" / "manifest.json").read_bytes() == (
+        tmp_path / "flt" / "manifest.json"
+    ).read_bytes()
+
+
+def test_quarantined_shard_excluded_job_completes(tmp_path, fault_plan):
+    shards = make_shards(tmp_path, n_shards=2)
+    bad = str(tmp_path / "bad.tar")
+    Path(bad).write_bytes(b"not a tar at all")
+    s, _ = run_job(
+        [shards[0], bad, shards[1]], tmp_path / "out",
+        retry=RetryPolicy(attempts=2, backoff_s=0.01),
+    )
+    assert s["complete"]
+    assert s["quarantined"] == [bad]
+    m = read_manifest(tmp_path / "out" / "manifest.json")
+    assert [e["shard"] for e in m["shards"]] == shards  # bad one excluded
+    done = [
+        e for e in read_journal(tmp_path / "out" / "journal")
+        if e["type"] == "job_shard_done" and e.get("status") == "quarantined"
+    ]
+    assert len(done) == 1 and done[0]["shard"] == bad
+
+
+def test_job_spec_validation(tmp_path):
+    with pytest.raises(ValueError):
+        JobSpec(shards=(), output_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        JobSpec(shards=("a", "a"), output_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        JobSpec(shards=("a",), output_dir=str(tmp_path), workers=0)
+    assert part_stem("gs://b/p/train-0001.tar") != part_stem("gs://b/q/train-0001.tar")
+
+
+# --------------------------------------------------- SIGKILL (subprocess)
+
+
+def _batch_cmd(shards, out, per_item_ms) -> list[str]:
+    return [
+        sys.executable, "-m", "jumbo_mae_tpu_tpu.cli.batch",
+        *shards, "--out", str(out), "--workers", "2",
+        "--lease-s", "1.0", "--service-per-item-ms", str(per_item_ms),
+    ]
+
+
+def _subproc_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("GRAFT_FAULTS", None)
+    return env
+
+
+def test_sigkill_midrun_restart_manifest_byte_identical(tmp_path):
+    """The whole-process chaos leg: SIGKILL the job (no handler can run,
+    torn partials and leaked leases on disk), restart the same command,
+    and the manifest must match a never-killed control run byte for byte."""
+    shards = make_shards(tmp_path, n_shards=3, n_samples=10)
+    env = _subproc_env()
+    ctrl = subprocess.run(
+        _batch_cmd(shards, tmp_path / "ctrl", 0.2), env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert ctrl.returncode == 0, ctrl.stdout[-2000:] + ctrl.stderr[-2000:]
+
+    # leg B: slow service so the kill lands mid-shard with work in flight
+    proc = subprocess.Popen(
+        _batch_cmd(shards, tmp_path / "flt", 30.0), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + 60.0
+    parts = tmp_path / "flt" / "parts"
+    while time.monotonic() < deadline:
+        if parts.is_dir() and any(
+            p.stat().st_size > 0 for p in parts.glob("*.partial")
+        ):
+            break  # durable progress exists; the kill now tears real state
+        time.sleep(0.05)
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+    assert read_manifest(tmp_path / "flt" / "manifest.json") is None
+
+    resumed = subprocess.run(
+        _batch_cmd(shards, tmp_path / "flt", 0.2), env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert resumed.returncode == 0, resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    summary = json.loads(resumed.stdout.strip().splitlines()[-1])
+    assert summary["complete"]
+    assert (tmp_path / "ctrl" / "manifest.json").read_bytes() == (
+        tmp_path / "flt" / "manifest.json"
+    ).read_bytes()
+
+
+# ---------------------------------------------------------------- doctor
+
+
+def test_batch_doctor_exit_codes_and_steal_attribution(tmp_path, fault_plan, capsys):
+    import tools.batch_doctor as doctor
+
+    shards = make_shards(tmp_path)
+    fault_plan("batch.worker:raise@key~w0,n<1")
+    run_job(shards, tmp_path / "job")
+    faults.clear_plan()
+    assert doctor.main([str(tmp_path / "job")]) == 0
+    report = capsys.readouterr().out
+    assert "stolen from `w0`" in report
+    assert "reconciles 100%" in report
+
+    # corrupt one byte of a part: reconciliation must fail
+    part = next((tmp_path / "job" / "parts").glob("*.part"))
+    data = bytearray(part.read_bytes())
+    data[-1] ^= 0xFF
+    part.write_bytes(bytes(data))
+    assert doctor.main([str(tmp_path / "job")]) == 2
+
+    # no manifest at all (incomplete or wrong dir)
+    assert doctor.main([str(tmp_path / "nowhere")]) == 2
+
+
+def test_batch_doctor_flags_orphan_part(tmp_path):
+    import tools.batch_doctor as doctor
+
+    shards = make_shards(tmp_path, n_shards=1)
+    run_job(shards, tmp_path / "job")
+    orphan = tmp_path / "job" / "parts" / "stray-deadbeef.part"
+    orphan.write_bytes(b"")
+    assert doctor.main([str(tmp_path / "job")]) == 2
+
+
+# ----------------------------------------------------- admission blocking
+
+
+def test_admit_wait_rides_out_transient_pressure():
+    pressures = [1.0, 1.0, 0.0]
+    adm = AdmissionController(
+        parse_tenants("job=batch"),
+        pressure_fn=lambda: pressures.pop(0) if pressures else 0.0,
+    )
+    sp = adm.admit_wait("job", timeout_s=5.0)
+    assert sp.tclass == "batch"
+    # permanent pressure: the last typed shed surfaces at the deadline
+    adm2 = AdmissionController(
+        parse_tenants("job=batch"), pressure_fn=lambda: 1.0
+    )
+    with pytest.raises(TenantPressureError):
+        adm2.admit_wait("job", timeout_s=0.1)
+
+
+# ------------------------------------------------------------- durability
+
+
+def test_fsync_dir_tolerates_missing_and_plain_paths(tmp_path):
+    fsync_dir(tmp_path)  # real directory: must not raise
+    fsync_dir(tmp_path / "does-not-exist")  # vanished: silently tolerated

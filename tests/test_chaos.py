@@ -103,6 +103,20 @@ class TestFaultPlan:
         dirty = plan.fire("data.decode", "bad-sample", b"payload00")
         assert dirty != b"payload00" and len(dirty) == len(b"payload00")
 
+    def test_key_filter_gates_the_invocation_counter(self):
+        # counting selectors index the rule's FILTERED stream: calls from
+        # other keys are invisible to it, so `key~r1,n<1` fires on r1's
+        # first call even when another key reaches the site first. (The
+        # old global counter made such rules race against interleaving —
+        # a worker/replica crash plan could silently never fire.)
+        plan = FaultPlan.parse("s:raise(RuntimeError)@key~r1,n<1")
+        for _ in range(3):  # r0 hammers the site first — doesn't count
+            plan.fire("s", "r0", None)
+        with pytest.raises(RuntimeError, match="fault injected"):
+            plan.fire("s", "r1", None)  # r1's first call still fires
+        plan.fire("s", "r1", None)  # r1's second call is clean
+        assert plan.counts() == {"s:raise": (2, 1)}
+
     def test_unknown_site_is_free(self):
         plan = FaultPlan.parse("train.loss:nan")
         assert plan.fire("some.other.site", None, b"x") == b"x"

@@ -801,3 +801,145 @@ def test_swap_headroom_rejection():
             rs2.close()
     finally:
         rs.close()
+
+
+# ------------------------------------------------------------- preemption
+
+
+def test_preempt_drains_zero_dropped_then_restarts(tmp_path):
+    """A preemption notice mid-traffic: the replica leaves routing, every
+    request it held resolves ok (zero dropped), the journal carries
+    ``replica_preempted``, the metric bumps, and the supervisor brings the
+    capacity back without a failure-count penalty."""
+    log = AccessLog(tmp_path / "access")
+    reg = MetricsRegistry()
+    tracer = RequestTracer(registry=reg, access_log=log)
+
+    def run(eng, batch, metas):
+        time.sleep(0.005)
+        return {"y": batch[:, 0, 0, 0].astype(np.float64)}
+
+    rs = ReplicaSet(
+        lambda i: StubEngine(i), run, replicas=2, max_batch=4,
+        max_delay_ms=1.0, supervise_interval_s=0.02,
+        restart_backoff_s=0.05, registry=reg, tracer=tracer,
+    )
+    futs, stop = [], threading.Event()
+
+    def pump():
+        for i in range(150):
+            if stop.is_set():
+                return
+            try:
+                futs.append(rs.submit(_img(i)))
+            except QueueFullError:
+                pass
+            time.sleep(0.002)
+
+    t = threading.Thread(target=pump)
+    t.start()
+    time.sleep(0.05)
+    assert rs.preempt(1) is True
+    t.join()
+    for f in futs:
+        assert f.result(timeout=10) is not None  # zero dropped
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if rs.stats()["replicas"]["r1"]["state"] == "up":
+            break
+        time.sleep(0.02)
+    st = rs.stats()["replicas"]["r1"]
+    assert st["state"] == "up"
+    assert st["gen"] == 1  # a fresh incarnation took the slot
+    assert st["restarts"] == 0  # preemption is not a failure
+    assert _counter(reg, "serve_replica_preempted_total",
+                    labels=("replica",), replica="r1") == 1
+    rs.close()
+    events = [e["type"] for e in read_journal((tmp_path / "access"))]
+    assert "replica_preempted" in events
+
+
+def test_preempt_rejects_down_restarting_and_closed():
+    rs, _ = _pool(replicas=2)
+    assert rs.preempt(7) is False  # out of range
+    with rs._state_lock:
+        rs._slots[1].state = "down"
+    assert rs.preempt(1) is False  # already down
+    with rs._state_lock:
+        rs._slots[1].state = "up"
+    rs.close()
+    assert rs.preempt(0) is False  # closed pool
+
+
+def test_serve_preempt_fault_site_drains_via_supervisor(fault_plan, tmp_path):
+    """``serve.preempt:raise@n=1`` fires on the supervisor's second site
+    visit (r1 on the first tick): the replica drains exactly as a manual
+    preempt() would, under the same zero-drop contract."""
+    log = AccessLog(tmp_path / "access")
+    reg = MetricsRegistry()
+    tracer = RequestTracer(registry=reg, access_log=log)
+    fault_plan("serve.preempt:raise@n=1")
+    rs = ReplicaSet(
+        lambda i: StubEngine(i), run_echo, replicas=2, max_batch=4,
+        max_delay_ms=1.0, supervise_interval_s=0.02,
+        restart_backoff_s=0.05, registry=reg, tracer=tracer,
+    )
+    futs = [rs.submit(_img(i)) for i in range(30)]
+    for f in futs:
+        assert f.result(timeout=10) is not None
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if _counter(reg, "serve_replica_preempted_total",
+                    labels=("replica",), replica="r1") == 1:
+            break
+        time.sleep(0.02)
+    assert _counter(reg, "serve_replica_preempted_total",
+                    labels=("replica",), replica="r1") == 1
+    rs.close()
+    events = [e["type"] for e in read_journal(tmp_path / "access")]
+    assert "replica_preempted" in events
+
+
+def test_close_during_restart_never_respawns_slot():
+    """Regression for the close/restart race: a restart thread past its
+    pre-build check must NOT install a new incarnation once close() has
+    latched shutdown — the old code checked ``_closed`` only before taking
+    the state lock, so a slot could respawn (live thread, live engine)
+    after the close sweep."""
+    built = threading.Event()
+    release = threading.Event()
+    crashed = threading.Event()
+
+    def provider(idx):
+        if crashed.is_set():
+            # the restart build: park here until close() has begun
+            built.set()
+            assert release.wait(10.0)
+        return StubEngine(idx)
+
+    victim = {}
+
+    def run(eng, batch, metas):
+        if not crashed.is_set():
+            crashed.set()
+            victim["idx"] = eng.idx
+            raise RuntimeError("die once")
+        return {"y": np.zeros(len(batch))}
+
+    rs, _ = _pool(run, provider=provider, replicas=2,
+                  restart_backoff_s=0.01, max_retries=1)
+    rs.submit(_img()).result(timeout=5)  # retried onto the survivor
+    assert built.wait(10.0)  # the restart thread is inside the provider
+    closer = threading.Thread(target=rs.close)
+    closer.start()
+    time.sleep(0.1)  # close() is joining; the latch is set
+    release.set()  # let the restart thread race the install
+    closer.join(timeout=10.0)
+    assert not closer.is_alive()
+    # the slot must not have respawned: no running worker thread, and the
+    # incarnation still the crashed gen-0 one (never replaced)
+    rep = rs.replica(victim["idx"])
+    assert rep.gen == 0
+    assert rep.thread is None or not rep.thread.is_alive()
+    with pytest.raises(ShutdownError):
+        rs.submit(_img())
