@@ -101,6 +101,41 @@ def build_parser() -> argparse.ArgumentParser:
         "once (steady offered load for chaos and canary runs)",
     )
     p.add_argument(
+        "--pack",
+        action="store_true",
+        help="--serve --replicas: token-packed dispatch — the continuous "
+        "scheduler coalesces requests by TOKEN budget (mixed resolutions "
+        "ride together) and each group runs through engine.predict_packed "
+        "as one packed executable. features/logits only; --pool cls|gap",
+    )
+    p.add_argument(
+        "--pack-budget",
+        type=int,
+        default=0,
+        metavar="TOKENS",
+        help="--pack: the scheduler's token fill target per dispatch group "
+        "(0 = the engine's max_tokens default); the packer itself keeps "
+        "rung headroom above this for flushes that merge groups",
+    )
+    p.add_argument(
+        "--pack-resolutions",
+        default="",
+        metavar="SPEC",
+        help="--pack --synthetic: seeded mixed-resolution traffic, e.g. "
+        "'224:0.5,448:0.3,896:0.2' (size:weight; sizes must be "
+        "patch-aligned and need posemb=sincos2d when non-native); "
+        "default: every request at the native size",
+    )
+    p.add_argument(
+        "--pack-parity-n",
+        type=int,
+        default=8,
+        metavar="N",
+        help="--pack: packed-vs-unpacked per-request parity gate over the "
+        "first N requests before serving traffic (0 = skip); a failed "
+        "gate aborts the run",
+    )
+    p.add_argument(
         "--tenants",
         default="",
         metavar="SPEC",
@@ -307,6 +342,23 @@ def main(argv: list[str] | None = None) -> Path | None:
     replicated = bool(args.serve and args.replicas > 0)
     if (args.tenants or args.autoscale) and not replicated:
         raise SystemExit("--tenants/--autoscale require --serve --replicas N")
+    pack_mix: list[tuple[int, float]] | None = None
+    if args.pack:
+        if not replicated:
+            raise SystemExit("--pack requires --serve --replicas N")
+        if args.task not in ("features", "logits"):
+            raise SystemExit(
+                "--pack serves the encoder-sharing tasks: features|logits"
+            )
+        if args.pool == "tokens":
+            raise SystemExit("--pack pools per segment: --pool cls or gap")
+        if args.pack_resolutions:
+            pack_mix = []
+            for part in args.pack_resolutions.split(","):
+                s, _, w = part.partition(":")
+                pack_mix.append((int(s), float(w or 1.0)))
+    elif args.pack_resolutions:
+        raise SystemExit("--pack-resolutions requires --pack")
     # restarts and promoted swaps read the checkpoint through this cell,
     # so a replica rebuilt after a promote comes up on the new weights
     ckpt_ref = {"ckpt": args.ckpt}
@@ -327,6 +379,15 @@ def main(argv: list[str] | None = None) -> Path | None:
             ckpt=ckpt_ref["ckpt"],
             dtype=args.dtype,
             max_batch=args.max_batch,
+            # the packer's rung ceiling, kept ABOVE the scheduler's fill
+            # target (--pack-budget): a busy replica merges consecutive
+            # dispatch groups into one flush, and rungs capped at the fill
+            # target would force pow2-row padding on those merged flushes
+            **(
+                {"max_tokens": max(args.pack_budget, 4096)}
+                if args.pack_budget
+                else {}
+            ),
             quant=args.quant,
             warm_cache=(
                 False if args.no_warmcache
@@ -430,6 +491,19 @@ def main(argv: list[str] | None = None) -> Path | None:
     if replicated:
         from jumbo_mae_tpu_tpu.infer import ReplicaSet, WeightSwapController
 
+        def _warm(eng):
+            if not args.warmup:
+                return
+            if args.pack:
+                # warm the per-resolution embed stages + the packed
+                # executable the representative mix's plan lands on
+                res_list = (
+                    [s for s, _ in pack_mix] if pack_mix else [eng.image_size]
+                )
+                eng.warmup_packed(res_list, (args.task,), pool=args.pool)
+            else:
+                eng.warmup((args.task,), pool=args.pool)
+
         def engine_provider(idx):
             # a (re)built replica compiles its own executables — during
             # chaos restarts that happens while the sentinel is armed, and
@@ -437,19 +511,26 @@ def main(argv: list[str] | None = None) -> Path | None:
             if retrace_sentinel is not None:
                 with retrace_sentinel.expected("replica build"):
                     eng = make_engine()
-                    if args.warmup:
-                        eng.warmup((args.task,), pool=args.pool)
+                    _warm(eng)
                     return eng
             eng = make_engine()
-            if args.warmup:
-                eng.warmup((args.task,), pool=args.pool)
+            _warm(eng)
             return eng
 
         def run_replica(eng, batch, metas):
-            if retrace_sentinel is None:
+            def _go():
+                if args.pack:
+                    # batch is the raw image list for mixed shapes (see
+                    # ReplicaSet._flush); one packed dispatch serves it
+                    return eng.predict_packed(
+                        list(batch), args.task, pool=args.pool
+                    )
                 return eng.predict(batch, task=args.task, **kw)
+
+            if retrace_sentinel is None:
+                return _go()
             retrace_sentinel.note("replica_batch", batch)
-            out = eng.predict(batch, task=args.task, **kw)
+            out = _go()
             retrace_sentinel.arm()  # first batch served: steady state
             return out
 
@@ -526,12 +607,30 @@ def main(argv: list[str] | None = None) -> Path | None:
 
     size = engine.image_size
     if args.synthetic:
-        images = (
-            np.random.RandomState(0)
-            .randint(0, 256, (args.synthetic, size, size, 3))
-            .astype(np.uint8)
-        )
-        names = [f"synthetic[{i}]" for i in range(args.synthetic)]
+        if pack_mix:
+            # seeded mixed-resolution traffic: same seed, same trace —
+            # the packed-vs-bucketed A/B compares like against like
+            rs_img = np.random.RandomState(0)
+            sizes = [s for s, _ in pack_mix]
+            w = np.array([max(wt, 0.0) for _, wt in pack_mix], np.float64)
+            w /= w.sum()
+            picks = rs_img.choice(len(sizes), size=args.synthetic, p=w)
+            images = [
+                rs_img.randint(
+                    0, 256, (sizes[c], sizes[c], 3)
+                ).astype(np.uint8)
+                for c in picks
+            ]
+            names = [
+                f"synthetic[{i}]@{im.shape[0]}" for i, im in enumerate(images)
+            ]
+        else:
+            images = (
+                np.random.RandomState(0)
+                .randint(0, 256, (args.synthetic, size, size, 3))
+                .astype(np.uint8)
+            )
+            names = [f"synthetic[{i}]" for i in range(args.synthetic)]
     else:
         from PIL import Image
 
@@ -575,7 +674,6 @@ def main(argv: list[str] | None = None) -> Path | None:
         if args.tenants:
             from jumbo_mae_tpu_tpu.serve import (
                 AdmissionController,
-                ContinuousScheduler,
                 CostMeter,
                 parse_tenants,
             )
@@ -589,12 +687,20 @@ def main(argv: list[str] | None = None) -> Path | None:
             meter = CostMeter(tenant_specs, tracer=tracer)
             rs.set_costmeter(meter)
             admission = AdmissionController(tenant_specs, meter=meter)
+            print(
+                "[predict] traffic shaping: "
+                + ", ".join(f"{t.name}={t.tclass}" for t in tenant_specs)
+            )
+        if args.tenants or args.pack:
+            from jumbo_mae_tpu_tpu.serve import ContinuousScheduler
+
             # the scheduler's accumulator becomes the admission-visible
             # queue; give the pool headroom above it so a dispatched group
             # doesn't race the pool's own hard cap and shed an
             # already-admitted interactive request
             if rs.max_queue is not None:
                 rs.max_queue = rs.max_queue + 2 * args.max_batch
+            pack_budget = args.pack_budget or engine.max_tokens
             sched = ContinuousScheduler(
                 rs.submit_group,
                 max_batch=args.max_batch,
@@ -603,17 +709,26 @@ def main(argv: list[str] | None = None) -> Path | None:
                 admission=admission,
                 tracer=tracer,
                 task=args.task,
+                packed=args.pack,
+                token_budget=pack_budget if args.pack else None,
+                seq_len_fn=(
+                    (lambda arr: engine.seq_len(arr.shape[0]))
+                    if args.pack
+                    else None
+                ),
             )
+            if args.pack:
+                print(
+                    f"[predict] token packing: budget={pack_budget} "
+                    f"tokens/dispatch, pool={args.pool}"
+                )
             # combined pressure: scheduler accumulator OR pool backlog —
             # either filling sheds low classes before interactive traffic
             # hits a hard queue-full
-            admission.set_pressure_fn(
-                lambda: max(sched.pressure(), rs.pressure())
-            )
-            print(
-                "[predict] traffic shaping: "
-                + ", ".join(f"{t.name}={t.tclass}" for t in tenant_specs)
-            )
+            if admission is not None:
+                admission.set_pressure_fn(
+                    lambda: max(sched.pressure(), rs.pressure())
+                )
         autoscaler = None
         if args.autoscale:
             from jumbo_mae_tpu_tpu.serve import Autoscaler, roofline_capacity
@@ -684,6 +799,23 @@ def main(argv: list[str] | None = None) -> Path | None:
                 f"[predict] swap-watch: polling {watch_root} "
                 f"every {args.swap_poll_s:g}s"
             )
+        if args.pack and args.pack_parity_n > 0:
+            # correctness gate before traffic: every packed output must
+            # match its own unpacked forward (cosine / top-1 agreement)
+            par = engine.packed_parity(
+                list(images[: args.pack_parity_n]),
+                args.task,
+                pool=args.pool,
+            )
+            cos = par["feature_cosine_min"]
+            t1 = par["logits_top1_agree"]
+            print(
+                f"[predict] pack parity: pass={par['pass']} n={par['n']} "
+                f"cosine_min={'-' if cos is None else format(cos, '.6f')} "
+                f"top1_agree={'-' if t1 is None else format(t1, '.4f')}"
+            )
+            if not par["pass"]:
+                raise SystemExit("[predict] pack parity gate FAILED")
         futs = []
         shed = 0
         for i, img in enumerate(images):
@@ -693,7 +825,11 @@ def main(argv: list[str] | None = None) -> Path | None:
                         sched.submit(
                             img,
                             deadline_ms=args.deadline_ms,
-                            tenant=tenant_names[i % len(tenant_names)],
+                            tenant=(
+                                tenant_names[i % len(tenant_names)]
+                                if tenant_names
+                                else None
+                            ),
                         )
                     )
                 else:
@@ -727,6 +863,12 @@ def main(argv: list[str] | None = None) -> Path | None:
             print(f"[predict] autoscale events: {len(autoscaler.events)}")
         if sched is not None:
             sched.close()
+            if args.pack:
+                st = sched.stats()
+                print(
+                    f"[predict] pack stats: dispatched={st['dispatched']} "
+                    f"batches={st['batches']} expired={st['expired']}"
+                )
             if admission is not None:
                 print(f"[predict] admission: {json.dumps(admission.stats())}")
         if meter is not None:

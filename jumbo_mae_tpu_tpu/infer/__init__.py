@@ -4,11 +4,17 @@ from jumbo_mae_tpu_tpu.infer.batching import (
     QueueFullError,
     ShutdownError,
 )
-from jumbo_mae_tpu_tpu.infer.engine import (
-    InferenceEngine,
+from jumbo_mae_tpu_tpu.infer.bucketing import (
     OversizedBatchError,
     bucket_for,
+    floor_bucket,
+    pow2_rungs,
 )
+from jumbo_mae_tpu_tpu.infer.engine import (
+    InferenceEngine,
+    ResolutionMismatchError,
+)
+from jumbo_mae_tpu_tpu.infer.packing import PackPlan, SegmentPlacement, pack_ffd
 from jumbo_mae_tpu_tpu.infer.quant import (
     QuantizedTensor,
     parity_report,
@@ -27,15 +33,21 @@ __all__ = [
     "InferenceEngine",
     "MicroBatcher",
     "OversizedBatchError",
+    "PackPlan",
     "PoolUnhealthyError",
     "QuantizedTensor",
     "QueueFullError",
     "ReplicaSet",
+    "ResolutionMismatchError",
     "RetriesExhaustedError",
+    "SegmentPlacement",
     "ShutdownError",
     "WarmCache",
     "WeightSwapController",
     "bucket_for",
+    "floor_bucket",
+    "pack_ffd",
     "parity_report",
+    "pow2_rungs",
     "quantize_params",
 ]

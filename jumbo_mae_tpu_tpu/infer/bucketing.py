@@ -1,0 +1,89 @@
+"""The one definition of the serving bucket ladder.
+
+Three call sites grew their own copies of the power-of-two bucket walk —
+the engine's ceil (``bucket_for``), the continuous scheduler's floor
+(``floor_bucket``), and the load generator's report-side ceil — and the
+invariant that keeps the whole tier honest lives *between* them:
+
+    floor_bucket(k) <= k <= bucket_for(k)            (k <= max_batch)
+    bucket_for(floor_bucket(k)) == floor_bucket(k)   (a floor is pad-free)
+
+A drifted copy breaks that silently: the scheduler would "align" partial
+batches to a size the engine then pads anyway, and the loadgen report
+would account pad rows the device never ran. Both functions live here and
+everywhere else imports them; ``tests/test_bucketing.py`` property-checks
+the pair against each other across the (n, max_batch) lattice so the
+invariant is enforced at the definition, not per call site.
+"""
+
+from __future__ import annotations
+
+
+class OversizedBatchError(ValueError):
+    """A single dispatch larger than the engine's ``max_batch`` — there is
+    no planned executable for that shape, and compiling one on the hot path
+    is exactly the latency cliff the bucket ladder exists to prevent.
+    ``InferenceEngine.predict`` never raises this (it chunks oversized
+    requests); direct ``bucket_for``/``warmup`` callers get it instead of a
+    silent unplanned compile."""
+
+
+def bucket_for(n: int, max_batch: int) -> int:
+    """Smallest power-of-two >= n, clamped to ``max_batch`` (so the number
+    of distinct compiled programs is log2(max_batch)+1, not one per
+    request size; a non-power-of-two ``max_batch`` is itself the last rung
+    of the ladder). ``n > max_batch`` raises :class:`OversizedBatchError` —
+    historically this silently returned a too-small (or, for non-pow2
+    ``max_batch``, a too-LARGE unplanned) bucket."""
+    if n <= 0:
+        raise ValueError(f"need a positive batch, got {n}")
+    if n > max_batch:
+        raise OversizedBatchError(
+            f"batch of {n} exceeds max_batch={max_batch} — split the "
+            f"request upstream (engine.predict chunks automatically) or "
+            f"raise max_batch"
+        )
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max_batch)
+
+
+def floor_bucket(k: int, max_batch: int) -> int:
+    """Largest engine pad-bucket size <= k: the engine pads every flush up
+    to a power-of-2 bucket (capped at max_batch, itself the top rung), so
+    a batch of exactly this size runs with zero pad rows."""
+    if k >= max_batch:
+        return max_batch
+    b = 1
+    while b * 2 <= k:
+        b *= 2
+    return b
+
+
+def ceil_pow2(n: int) -> int:
+    """Smallest power of two >= n (no ladder cap — the packed executable's
+    row/segment-slot dimensions bucket this way so the number of distinct
+    compiled shapes stays logarithmic)."""
+    if n <= 0:
+        raise ValueError(f"need a positive count, got {n}")
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pow2_rungs(max_value: int) -> tuple[int, ...]:
+    """Every power of two <= ``max_value``, plus ``max_value`` itself when
+    it is not one — the engine's warmup ladder and the token-budget rung
+    set for packed serving share this shape."""
+    if max_value < 1:
+        raise ValueError(f"need a positive max, got {max_value}")
+    rungs = []
+    b = 1
+    while b <= max_value:
+        rungs.append(b)
+        b <<= 1
+    if rungs[-1] != max_value:
+        rungs.append(max_value)
+    return tuple(rungs)

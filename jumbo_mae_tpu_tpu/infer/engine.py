@@ -71,7 +71,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from jumbo_mae_tpu_tpu.config import TrainConfig
+from jumbo_mae_tpu_tpu.infer import packing
 from jumbo_mae_tpu_tpu.infer import warmcache as wc
+from jumbo_mae_tpu_tpu.infer.bucketing import ceil_pow2
 from jumbo_mae_tpu_tpu.infer.quant import dequantize_tree, quantize_params
 from jumbo_mae_tpu_tpu.obs import lockwatch
 from jumbo_mae_tpu_tpu.obs.metrics import RATIO_BUCKETS, get_registry
@@ -98,35 +100,31 @@ from jumbo_mae_tpu_tpu.utils.procenv import (
 
 POOLS = ("cls", "gap", "tokens")
 
+# bucket math lives in infer/bucketing.py (one definition, property-tested);
+# re-exported here because this module was its historical home
+from jumbo_mae_tpu_tpu.infer.bucketing import (  # noqa: E402,F401
+    OversizedBatchError,
+    bucket_for,
+    pow2_rungs,
+)
 
-class OversizedBatchError(ValueError):
-    """A single dispatch larger than the engine's ``max_batch`` — there is
-    no planned executable for that shape, and compiling one on the hot path
-    is exactly the latency cliff the bucket ladder exists to prevent.
-    ``InferenceEngine.predict`` never raises this (it chunks oversized
-    requests); direct ``bucket_for``/``warmup`` callers get it instead of a
-    silent unplanned compile."""
 
+class ResolutionMismatchError(ValueError):
+    """Input resolution differs from what the engine's image-bucket
+    executables were compiled for. Typed (rather than a bare ValueError)
+    so a scheduler/router can catch it and route the request to the
+    token-packed path — which accepts any patch-aligned resolution —
+    instead of failing the request. ``expected`` is the engine's native
+    square size; ``got`` the offending (H, W)."""
 
-def bucket_for(n: int, max_batch: int) -> int:
-    """Smallest power-of-two >= n, clamped to ``max_batch`` (so the number
-    of distinct compiled programs is log2(max_batch)+1, not one per
-    request size; a non-power-of-two ``max_batch`` is itself the last rung
-    of the ladder). ``n > max_batch`` raises :class:`OversizedBatchError` —
-    historically this silently returned a too-small (or, for non-pow2
-    ``max_batch``, a too-LARGE unplanned) bucket."""
-    if n <= 0:
-        raise ValueError(f"need a positive batch, got {n}")
-    if n > max_batch:
-        raise OversizedBatchError(
-            f"batch of {n} exceeds max_batch={max_batch} — split the "
-            f"request upstream (engine.predict chunks automatically) or "
-            f"raise max_batch"
+    def __init__(self, expected: int, got: tuple[int, int]):
+        self.expected = int(expected)
+        self.got = (int(got[0]), int(got[1]))
+        super().__init__(
+            f"engine is compiled for {expected}px inputs, got "
+            f"{got[0]}x{got[1]} — resize upstream or route to the "
+            f"token-packed path (predict_packed)"
         )
-    b = 1
-    while b < n:
-        b <<= 1
-    return min(b, max_batch)
 
 
 def _to_state_dict(tree) -> dict:
@@ -198,6 +196,7 @@ class InferenceEngine:
         ckpt: str = "",
         dtype: str | None = None,
         max_batch: int = 64,
+        max_tokens: int = 4096,
         labels: int | None = None,
         batch_norm: bool | None = None,
         quant: str | None = None,
@@ -288,16 +287,52 @@ class InferenceEngine:
             "measured / roofline-predicted execution time",
             labels=("program",),
         )
+        # token-packed serving observability (see predict_packed)
+        self._m_pack_pad = reg.histogram(
+            "serve_pack_token_pad_fraction",
+            "padding tokens / device tokens per packed dispatch "
+            "(row bucketing included)",
+            buckets=RATIO_BUCKETS,
+        )
+        self._m_pack_segments = reg.histogram(
+            "serve_pack_segments_per_dispatch",
+            "request segments packed into one dispatch",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+        )
+        self._m_pack_occ = reg.histogram(
+            "serve_pack_budget_occupancy",
+            "occupied tokens / (rows x token budget) per packed dispatch",
+            buckets=RATIO_BUCKETS,
+        )
+        self._m_pack_dispatches = reg.counter(
+            "serve_pack_dispatches_total",
+            "token-packed dispatches served",
+            labels=("task",),
+        )
+        self._m_pack_parity = reg.gauge(
+            "serve_pack_parity_min",
+            "min packed-vs-unpacked feature cosine of the last parity gate",
+        )
+        self._m_pack_parity_fail = reg.counter(
+            "serve_pack_parity_failures_total",
+            "packed-parity gate failures (cosine or top-1 below threshold)",
+        )
         self._registry = reg
         self.cfg = cfg
         self.max_batch = int(max_batch)
+        # packed-path token budget ceiling: the rung ladder tops out here
+        # (4096 covers 896px/patch16 = 3136 patch tokens + CLS)
+        self.max_tokens = int(max_tokens)
         self.on_compile = on_compile
         m = cfg.model
         overrides = dict(m.overrides)
         if dtype is not None:
             overrides["dtype"] = dtype
         # serving is always deterministic — stochastic knobs forced off,
-        # LAST, so recipe overrides can't re-enable them
+        # LAST, so recipe overrides can't re-enable them. grad_ckpt too:
+        # there are no gradients to checkpoint for, and the packed forward
+        # passes a traced pytree positionally past the remat wrapper's
+        # static deterministic flag.
         self._enc = preset(
             m.preset,
             **{
@@ -306,6 +341,7 @@ class InferenceEngine:
                 "mask_ratio": None,
                 "dropout": 0.0,
                 "droppath": 0.0,
+                "grad_ckpt": False,
             },
         )
         self._labels = labels if labels is not None else overrides.get("labels")
@@ -627,6 +663,24 @@ class InferenceEngine:
             self._fingerprint, task_key, bucket, str(self._enc.dtype), self.quant
         )
 
+    def _task_cfg(self, base: str):
+        """The encoder config a base task's model was built with — what the
+        packed path's per-resolution variants must replicate (same params
+        tree, different image_size)."""
+        if base == "logits":
+            return self._enc.replace(
+                labels=int(self._labels), batch_norm=self._batch_norm
+            )
+        return self._enc
+
+    @staticmethod
+    def _packed_dims(task: str) -> tuple[int, int]:
+        """Parse (rows, max_segments) out of a packed task key
+        (``<base>.packed:<pool>@r<rows>s<smax>``)."""
+        spec = task.rsplit("@", 1)[1]
+        r, s = spec[1:].split("s", 1)
+        return int(r), int(s)
+
     def _fn(self, task: str, pool: str | None):
         t = self._task(self._base_task(task))
         model = t["model"]
@@ -637,6 +691,63 @@ class InferenceEngine:
             # view is an on-chip intermediate fused into the consumers
             return dequantize_tree(variables) if quantized else variables
 
+        if ".embed@" in task:
+            # per-resolution patch embedding: the packed pipeline's stage 1.
+            # Same variables tree as the base task — only the (traced)
+            # image_size differs, and with sincos2d posemb the params are
+            # resolution-independent, so the graft/quant state is shared.
+            res = int(task.rsplit("@", 1)[1])
+            model_r = JumboViT(
+                self._task_cfg(self._base_task(task)).replace(image_size=res)
+            )
+
+            def fn(variables, images):
+                v = prep(variables)
+                x = normalize_images(images, dtype=self._enc.compute_dtype)
+                toks = model_r.apply(
+                    {"params": v["params"]}, x, method=JumboViT.patchify
+                )
+                return toks.astype(jnp.float32)
+
+            return fn
+        if ".full:" in task:
+            # unpacked full forward at an arbitrary resolution — the packed
+            # path's per-request parity oracle (same output contract as
+            # serve_packed: {"pooled", "logits"?})
+            pool_name = task.split(".full:", 1)[1].rsplit("@", 1)[0]
+            res = int(task.rsplit("@", 1)[1])
+            model_r = JumboViT(
+                self._task_cfg(self._base_task(task)).replace(image_size=res)
+            )
+
+            def fn(variables, images):
+                v = prep(variables)
+                x = normalize_images(images, dtype=self._enc.compute_dtype)
+                return model_r.apply(
+                    v, x, True, pooling=pool_name, method=JumboViT.serve_full
+                )
+
+            return fn
+        if ".packed:" in task:
+            # token-packed forward: consumes pre-embedded token segments,
+            # so one executable serves every resolution in the mix (and
+            # both features + logits when the base task has a head)
+            pool_name = task.split(".packed:", 1)[1].rsplit("@", 1)[0]
+
+            def fn(variables, tokens, seg, cls_pos, cls_index):
+                v = prep(variables)
+                return model.apply(
+                    v,
+                    tokens,
+                    seg,
+                    cls_pos,
+                    cls_index,
+                    True,
+                    pooling=pool_name,
+                    method=JumboViT.serve_packed,
+                )
+
+            return fn
         if task == "features":
             k = self._enc.num_cls_tokens
 
@@ -715,6 +826,24 @@ class InferenceEngine:
         """Lowering arguments for one executable: the task's (possibly
         quantized) variables tree plus shape-only stand-ins for the data."""
         size = self.image_size
+        if ".embed@" in task or ".full:" in task:
+            res = int(task.rsplit("@", 1)[1])
+            return [
+                t["variables"],
+                jax.ShapeDtypeStruct((bucket, res, res, 3), jnp.uint8),
+            ]
+        if ".packed:" in task:
+            # packed executables key rows/segment-slots into the task name;
+            # ``bucket`` is the token budget
+            rows, smax = self._packed_dims(task)
+            k = self._enc.num_cls_tokens
+            return [
+                t["variables"],
+                jax.ShapeDtypeStruct((rows, bucket, self._enc.dim), jnp.float32),
+                jax.ShapeDtypeStruct((rows, bucket), jnp.int32),
+                jax.ShapeDtypeStruct((rows, bucket), jnp.int32),
+                jax.ShapeDtypeStruct((rows, smax, k), jnp.int32),
+            ]
         if task == "reconstruct.dec":
             enc = t["enc_cfg"]
             seq = enc.num_cls_tokens + enc.keep_len
@@ -987,9 +1116,8 @@ class InferenceEngine:
         if images.ndim != 4 or images.shape[-1] != 3:
             raise ValueError(f"expected (n, H, W, 3) uint8 images, got {images.shape}")
         if images.shape[1] != self.image_size or images.shape[2] != self.image_size:
-            raise ValueError(
-                f"engine is compiled for {self.image_size}px inputs, got "
-                f"{images.shape[1]}x{images.shape[2]} — resize upstream"
+            raise ResolutionMismatchError(
+                self.image_size, (images.shape[1], images.shape[2])
             )
         return images.astype(np.uint8, copy=False)
 
@@ -1184,3 +1312,294 @@ class InferenceEngine:
         if task == "reconstruct":
             return self.reconstruct(images, **kw)
         raise ValueError(f"unknown task {task!r}")
+
+    # ------------------------------------------------- token-packed serving
+
+    def seq_len(self, size: int) -> int:
+        """Token count of one packed request at a square resolution:
+        ``num_cls_tokens + (size/patch)²``. Raises on non-patch-aligned
+        sizes — packing plans in whole patch tokens."""
+        p = self._enc.patch_size
+        size = int(size)
+        if size < p or size % p:
+            raise ValueError(
+                f"image size {size} is not a positive multiple of "
+                f"patch_size={p} — packed serving needs patch-aligned inputs"
+            )
+        return self._enc.num_cls_tokens + (size // p) ** 2
+
+    def _check_packed_request(self, imgs: list, task_list: list) -> list[int]:
+        """Validate a packed request mix; returns per-request token counts."""
+        lengths = []
+        for i, im in enumerate(imgs):
+            if im.ndim != 3 or im.shape[-1] != 3:
+                raise ValueError(
+                    f"packed request {i}: expected one (H, W, 3) uint8 "
+                    f"image, got {im.shape}"
+                )
+            h, w = int(im.shape[0]), int(im.shape[1])
+            if h != w:
+                raise ValueError(
+                    f"packed request {i}: expected a square image, got "
+                    f"{h}x{w}"
+                )
+            if h != self.image_size and self._enc.posemb != "sincos2d":
+                raise ValueError(
+                    f"packed request {i} is {h}px but the engine's native "
+                    f"size is {self.image_size}px and posemb="
+                    f"{self._enc.posemb!r} is resolution-locked — serve "
+                    f"mixed resolutions with posemb='sincos2d'"
+                )
+            lengths.append(self.seq_len(h))
+        bad = sorted({t for t in task_list if t not in ("features", "logits")})
+        if bad:
+            raise ValueError(
+                f"packed serving covers the encoder-sharing tasks "
+                f"features/logits; got {bad}"
+            )
+        return lengths
+
+    def _embed_requests(
+        self, imgs: list, tree_task: str
+    ) -> list[np.ndarray]:
+        """Stage 1 of the packed pipeline: per-resolution patch embedding
+        (image-count-bucketed executables), one (n_patches, dim) float32
+        token array per request."""
+        patch_tokens: list = [None] * len(imgs)
+        by_res: dict[int, list[int]] = {}
+        for i, im in enumerate(imgs):
+            by_res.setdefault(int(im.shape[0]), []).append(i)
+        for res, idxs in sorted(by_res.items()):
+            stack = np.stack([imgs[i] for i in idxs]).astype(np.uint8, copy=False)
+            for off in range(0, len(idxs), self.max_batch):
+                out = self._run(
+                    f"{tree_task}.embed@{res}",
+                    None,
+                    stack[off : off + self.max_batch],
+                )
+                for j, i_req in enumerate(idxs[off : off + self.max_batch]):
+                    patch_tokens[i_req] = out[j]
+        return patch_tokens
+
+    def predict_packed(
+        self,
+        images,
+        tasks="features",
+        *,
+        pool: str = "cls",
+        max_tokens: int | None = None,
+    ) -> list[np.ndarray]:
+        """Serve a mixed-resolution, mixed-task request list through ONE
+        token-packed dispatch instead of one padded image bucket per
+        ``(task, shape)``.
+
+        ``images`` is a list of square, patch-aligned ``(H, W, 3)`` uint8
+        arrays (224–896px etc. — any patch multiple; non-native sizes need
+        ``posemb='sincos2d'``). ``tasks`` is one task name or one per
+        request, from ``features``/``logits`` — the encoder-sharing pair
+        that can ride one executable (when any request wants logits, the
+        whole pack runs on the logits task's tree, whose encoder is the
+        same grafted checkpoint). Returns one float32 row per request, in
+        request order.
+
+        Pipeline: per-resolution patch embedding (stage 1, image-count
+        buckets) → deterministic FFD pack of the token segments into a
+        power-of-2 token-budget rung (``infer/packing.py``) → one packed
+        executable keyed by (rows, max_segments, budget). Pad tokens are
+        provably inert (block-diagonal segment attention), and
+        ``last_breakdown().pad_fraction`` reports the *token*-level pad of
+        the packed dispatch — the costmeter bills waste from it.
+        """
+        if pool not in ("cls", "gap"):
+            raise ValueError(
+                f"packed serving pools per segment: pool must be 'cls' or "
+                f"'gap', got {pool!r}"
+            )
+        imgs = [np.asarray(im) for im in images]
+        n = len(imgs)
+        if n == 0:
+            return []
+        task_list = [tasks] * n if isinstance(tasks, str) else list(tasks)
+        if len(task_list) != n:
+            raise ValueError(
+                f"{n} images but {len(task_list)} tasks — pass one task "
+                f"name or one per request"
+            )
+        lengths = self._check_packed_request(imgs, task_list)
+        tree_task = (
+            "logits" if any(t == "logits" for t in task_list) else "features"
+        )
+
+        t0 = time.perf_counter()
+        self._reset_breakdown()
+        patch_tokens = self._embed_requests(imgs, tree_task)
+        # stage-1 image buckets are tiny next to the packed dispatch; reset
+        # the pad accounting so last_breakdown() reports the packed
+        # dispatch's TOKEN pad fraction (compute/fetch keep accumulating)
+        self._tls.bd["pad_rows"] = 0
+        self._tls.bd["bucket_rows"] = 0
+
+        k = self._enc.num_cls_tokens
+        rungs = packing.budget_rungs(int(max_tokens or self.max_tokens))
+        budget, plan = packing.choose_budget(lengths, rungs)
+        rows_b = ceil_pow2(plan.rows)
+        smax_b = ceil_pow2(plan.max_segments)
+        arrays = packing.build_arrays(plan, k, rows=rows_b, max_segments=smax_b)
+        buf = packing.place_tokens(plan, patch_tokens, k, rows=rows_b)
+
+        task_key = f"{tree_task}.packed:{pool}@r{rows_b}s{smax_b}"
+        ex = self._executable(task_key, None, budget)
+        t = self._task(tree_task)
+        t_compute = time.perf_counter()
+        out = ex(
+            t["variables"],
+            buf,
+            arrays["segment_ids"],
+            arrays["cls_pos"],
+            arrays["cls_index"],
+        )
+        jax.block_until_ready(out)
+        t_fetch = time.perf_counter()
+        out = jax.tree_util.tree_map(np.asarray, out)
+        bd = self._tls.bd
+        bd["compute_s"] += t_fetch - t_compute
+        bd["fetch_s"] += time.perf_counter() - t_fetch
+        device_tokens = rows_b * budget
+        total_tokens = plan.total_tokens
+        bd["bucket"] = max(bd["bucket"], budget)
+        bd["pad_rows"] += device_tokens - total_tokens
+        bd["bucket_rows"] += device_tokens
+        pred = self._pred_s.get((task_key, budget))
+        if pred:
+            self._m_drift.labels(f"{task_key}/b{budget}").set(
+                (t_fetch - t_compute) / pred
+            )
+
+        self._m_pack_pad.observe((device_tokens - total_tokens) / device_tokens)
+        self._m_pack_segments.observe(len(plan.segments))
+        self._m_pack_occ.observe(total_tokens / device_tokens)
+        self._m_pack_dispatches.labels(tree_task).inc()
+        self._m_predict.labels("packed").observe(time.perf_counter() - t0)
+        self._m_images.labels("packed").inc(n)
+
+        pooled = packing.unpack_rows(plan, out["pooled"])
+        logits = (
+            packing.unpack_rows(plan, out["logits"]) if "logits" in out else None
+        )
+        return [
+            logits[i] if task_list[i] == "logits" else pooled[i]
+            for i in range(n)
+        ]
+
+    def packed_parity(
+        self,
+        images,
+        tasks="features",
+        *,
+        pool: str = "cls",
+        max_tokens: int | None = None,
+        feature_cos_min: float = 0.999,
+        logits_top1_min: float = 0.98,
+    ) -> dict:
+        """Per-request numeric parity of the packed path against the
+        unpacked forward on the SAME task tree — the packed rollout's
+        correctness gate (same thresholds as the int8 quant gate:
+        feature cosine >= 0.999, logits top-1 agreement >= 0.98)."""
+        imgs = [np.asarray(im) for im in images]
+        n = len(imgs)
+        task_list = [tasks] * n if isinstance(tasks, str) else list(tasks)
+        packed = self.predict_packed(
+            imgs, task_list, pool=pool, max_tokens=max_tokens
+        )
+        tree_task = (
+            "logits" if any(t == "logits" for t in task_list) else "features"
+        )
+        ref_pooled: list = [None] * n
+        ref_logits: list = [None] * n
+        by_res: dict[int, list[int]] = {}
+        for i, im in enumerate(imgs):
+            by_res.setdefault(int(im.shape[0]), []).append(i)
+        self._reset_breakdown()
+        for res, idxs in sorted(by_res.items()):
+            stack = np.stack([imgs[i] for i in idxs]).astype(np.uint8, copy=False)
+            for off in range(0, len(idxs), self.max_batch):
+                out = self._run(
+                    f"{tree_task}.full:{pool}@{res}",
+                    None,
+                    stack[off : off + self.max_batch],
+                )
+                for j, i_req in enumerate(idxs[off : off + self.max_batch]):
+                    ref_pooled[i_req] = out["pooled"][j]
+                    if "logits" in out:
+                        ref_logits[i_req] = out["logits"][j]
+        cosines: list[float] = []
+        top1: list[int] = []
+        rows = []
+        for i in range(n):
+            if task_list[i] == "logits":
+                agree = int(np.argmax(packed[i]) == np.argmax(ref_logits[i]))
+                top1.append(agree)
+                rows.append({"task": "logits", "top1_agree": agree})
+            else:
+                a = packed[i].ravel().astype(np.float64)
+                b = ref_pooled[i].ravel().astype(np.float64)
+                denom = np.linalg.norm(a) * np.linalg.norm(b)
+                cos = float(a @ b / denom) if denom else 1.0
+                cosines.append(cos)
+                rows.append({"task": "features", "cosine": round(cos, 6)})
+        cos_min = min(cosines) if cosines else None
+        top1_agree = float(np.mean(top1)) if top1 else None
+        ok = (cos_min is None or cos_min >= feature_cos_min) and (
+            top1_agree is None or top1_agree >= logits_top1_min
+        )
+        self._m_pack_parity.set(cos_min if cos_min is not None else 1.0)
+        if not ok:
+            self._m_pack_parity_fail.inc()
+        return {
+            "n": n,
+            "pool": pool,
+            "feature_cosine_min": cos_min,
+            "logits_top1_agree": top1_agree,
+            "feature_cos_threshold": feature_cos_min,
+            "logits_top1_threshold": logits_top1_min,
+            "pass": ok,
+            "requests": rows,
+        }
+
+    def warmup_packed(
+        self,
+        resolutions,
+        tasks: tuple[str, ...] = ("features",),
+        *,
+        pool: str = "cls",
+        max_tokens: int | None = None,
+    ) -> int:
+        """Precompile the packed path for a representative resolution mix:
+        each resolution's embed executable plus the packed executable the
+        mix's FFD plan lands on. Returns compiles performed (warmcache
+        loads are free, same contract as :meth:`warmup`)."""
+        resolutions = [int(r) for r in resolutions]
+        if not resolutions:
+            return 0
+        tree_task = "logits" if "logits" in tuple(tasks) else "features"
+        lengths = [self.seq_len(r) for r in resolutions]
+        rungs = packing.budget_rungs(int(max_tokens or self.max_tokens))
+        budget, plan = packing.choose_budget(lengths, rungs)
+        rows_b = ceil_pow2(plan.rows)
+        smax_b = ceil_pow2(plan.max_segments)
+        before = sum(self.compile_counts.values())
+        t0 = time.perf_counter()
+        counts: dict[int, int] = {}
+        for r in resolutions:
+            counts[r] = counts.get(r, 0) + 1
+        for res, cnt in sorted(counts.items()):
+            self._executable(
+                f"{tree_task}.embed@{res}",
+                None,
+                bucket_for(min(cnt, self.max_batch), self.max_batch),
+            )
+        self._executable(
+            f"{tree_task}.packed:{pool}@r{rows_b}s{smax_b}", None, budget
+        )
+        self._m_warm_start.set(time.perf_counter() - t0)
+        return sum(self.compile_counts.values()) - before

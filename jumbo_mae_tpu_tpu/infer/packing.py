@@ -1,0 +1,233 @@
+"""Token-packing plans: many variable-length requests, one executable.
+
+The image-bucketed serving path pads every dispatch up to a per-``(task,
+shape)`` power-of-2 *image* bucket, so mixed-resolution traffic pads each
+resolution to its own bucket and the pad rows burn MXU cycles
+(`infer_pad_fraction` tells the story per shape; the costmeter bills the
+waste). NaViT-style sequence packing (arXiv:2307.06304) recovers that
+waste: each request becomes a variable-length *token segment* (its CLS
+slots + patch tokens) and segments from different requests — different
+resolutions, different tasks sharing the encoder — are packed into the
+rows of one fixed ``(rows, token_budget)`` buffer served by one AOT
+executable.
+
+This module is the host-side planner; it is pure numpy and fully
+deterministic (sorted first-fit-decreasing, ties broken by request index
+— same requests, same plan, every time; asserted by
+``tests/test_packing.py``). The device-side contract it plans for:
+
+- ``segment_ids`` (rows, budget) int32: ``slot+1`` on every token a
+  segment owns, 0 on padding — the block-diagonal attention mask is
+  ``same-id AND id>0`` (plus the diagonal, so all-pad rows softmax over
+  themselves instead of NaN-ing);
+- ``cls_pos`` (rows, budget) int32: ``0..k-1`` on the segment's k leading
+  CLS slots, -1 elsewhere — where the encoder injects its ``cls_tokens``
+  parameter (exact: this architecture adds posemb to patches only);
+- ``cls_index`` (rows, max_segments, k) int32: each slot's CLS token
+  coordinates, for the per-segment jumbo-MLP gather/scatter and pooling.
+
+Padding is provably inert in both directions: pad tokens attend only to
+themselves (they never read a real token) and real tokens never attend to
+pads (mask), so perturbing one segment cannot move any other segment's
+output — ``tests/test_packed_model.py`` asserts this bit-exactly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from jumbo_mae_tpu_tpu.infer.bucketing import bucket_for, pow2_rungs
+
+
+@dataclass(frozen=True)
+class SegmentPlacement:
+    """One request's segment inside a pack: ``request`` is the index into
+    the caller's request list; ``length`` the segment's token count
+    (k CLS slots + patch tokens); ``row``/``slot`` its row and per-row
+    segment slot; ``offset`` the row position of its first token."""
+
+    request: int
+    length: int
+    row: int
+    slot: int
+    offset: int
+
+
+@dataclass(frozen=True)
+class PackPlan:
+    """A deterministic packing of segments into ``rows`` rows of
+    ``budget`` tokens. ``max_segments`` is the largest per-row segment
+    count (the executable's slot dimension)."""
+
+    budget: int
+    rows: int
+    max_segments: int
+    segments: tuple[SegmentPlacement, ...]
+
+    @property
+    def total_tokens(self) -> int:
+        return sum(s.length for s in self.segments)
+
+    def pad_fraction(self, rows: int | None = None) -> float:
+        """Token pad fraction of the dispatched buffer: padded tokens /
+        device tokens, over ``rows`` rows (default: the plan's own —
+        pass the row-bucketed count for what the device actually ran)."""
+        r = self.rows if rows is None else int(rows)
+        dev = r * self.budget
+        return (dev - self.total_tokens) / dev if dev else 0.0
+
+
+def pack_ffd(lengths, budget: int) -> PackPlan:
+    """First-fit-decreasing pack of ``lengths`` token segments into rows
+    of ``budget`` tokens. Deterministic: segments are placed longest
+    first (ties by request index), each into the first row with room.
+    A segment longer than the budget is a planning error, not a truncate.
+    """
+    budget = int(budget)
+    if budget < 1:
+        raise ValueError(f"need a positive token budget, got {budget}")
+    lengths = [int(n) for n in lengths]
+    if not lengths:
+        return PackPlan(budget=budget, rows=0, max_segments=0, segments=())
+    for i, n in enumerate(lengths):
+        if n < 1:
+            raise ValueError(f"segment {i} has non-positive length {n}")
+        if n > budget:
+            raise ValueError(
+                f"segment {i} needs {n} tokens > budget {budget} — pick a "
+                f"larger rung (choose_budget does this automatically)"
+            )
+    order = sorted(range(len(lengths)), key=lambda i: (-lengths[i], i))
+    row_fill: list[int] = []
+    row_slots: list[int] = []
+    placed: list[SegmentPlacement] = []
+    for i in order:
+        n = lengths[i]
+        for r in range(len(row_fill)):
+            if row_fill[r] + n <= budget:
+                placed.append(
+                    SegmentPlacement(
+                        request=i, length=n, row=r,
+                        slot=row_slots[r], offset=row_fill[r],
+                    )
+                )
+                row_fill[r] += n
+                row_slots[r] += 1
+                break
+        else:
+            placed.append(
+                SegmentPlacement(request=i, length=n, row=len(row_fill),
+                                 slot=0, offset=0)
+            )
+            row_fill.append(n)
+            row_slots.append(1)
+    placed.sort(key=lambda s: s.request)
+    return PackPlan(
+        budget=budget,
+        rows=len(row_fill),
+        max_segments=max(row_slots),
+        segments=tuple(placed),
+    )
+
+
+def choose_budget(
+    lengths, rungs, *, max_rows: int | None = None
+) -> tuple[int, PackPlan]:
+    """Pick the rung minimizing total device tokens — ``row-bucketed rows
+    × budget`` (rows pad to a power of two the same way image batches do,
+    so a small budget that fragments into many rows loses to a larger one
+    that packs tight). Ties break toward the smaller budget; fully
+    deterministic. Returns ``(budget, plan)``."""
+    need = max(int(n) for n in lengths)
+    usable = [b for b in rungs if b >= need]
+    if not usable:
+        raise ValueError(
+            f"largest segment needs {need} tokens but the rung ladder tops "
+            f"out at {max(rungs)} — raise the packed token budget"
+        )
+    best = None
+    for b in sorted(usable):
+        plan = pack_ffd(lengths, b)
+        rows_cap = max_rows if max_rows is not None else max(plan.rows, 1)
+        rows_b = bucket_for(plan.rows, max(rows_cap, plan.rows))
+        total = rows_b * b
+        if best is None or total < best[0]:
+            best = (total, b, plan)
+    return best[1], best[2]
+
+
+def budget_rungs(max_budget: int, *, min_budget: int = 64) -> tuple[int, ...]:
+    """The packed executable ladder's budget rungs: powers of two from
+    ``min_budget`` up to ``max_budget`` (plus ``max_budget`` itself when
+    it is not one) — same shape as the engine's image-bucket ladder."""
+    return tuple(b for b in pow2_rungs(max_budget) if b >= min_budget) or (
+        max_budget,
+    )
+
+
+def build_arrays(
+    plan: PackPlan,
+    num_cls_tokens: int,
+    *,
+    rows: int | None = None,
+    max_segments: int | None = None,
+) -> dict[str, np.ndarray]:
+    """Materialize the device-side plan arrays (see module docstring).
+    ``rows``/``max_segments`` may be rounded up past the plan's own values
+    (executable-shape bucketing); the extra rows/slots are all-pad and
+    inert."""
+    k = int(num_cls_tokens)
+    r = plan.rows if rows is None else int(rows)
+    smax = plan.max_segments if max_segments is None else int(max_segments)
+    if r < plan.rows or smax < plan.max_segments:
+        raise ValueError(
+            f"plan needs rows>={plan.rows}, max_segments>="
+            f"{plan.max_segments}; got rows={r}, max_segments={smax}"
+        )
+    seg = np.zeros((r, plan.budget), np.int32)
+    cls_pos = np.full((r, plan.budget), -1, np.int32)
+    cls_index = np.zeros((r, smax, k), np.int32)
+    for s in plan.segments:
+        seg[s.row, s.offset : s.offset + s.length] = s.slot + 1
+        cls_pos[s.row, s.offset : s.offset + k] = np.arange(k, dtype=np.int32)
+        cls_index[s.row, s.slot] = s.offset + np.arange(k, dtype=np.int32)
+    return {"segment_ids": seg, "cls_pos": cls_pos, "cls_index": cls_index}
+
+
+def place_tokens(
+    plan: PackPlan,
+    patch_tokens,
+    num_cls_tokens: int,
+    *,
+    rows: int | None = None,
+    dtype=np.float32,
+) -> np.ndarray:
+    """Scatter per-request patch-token arrays (``patch_tokens[i]`` is
+    request i's ``(length - k, dim)`` array) into the packed ``(rows,
+    budget, dim)`` host buffer. CLS slots and padding stay zero — the
+    encoder injects its CLS parameter on device; pad values are masked
+    out of every cross-token op."""
+    k = int(num_cls_tokens)
+    r = plan.rows if rows is None else int(rows)
+    dim = int(np.shape(patch_tokens[0])[-1])
+    buf = np.zeros((r, plan.budget, dim), dtype)
+    for s in plan.segments:
+        toks = np.asarray(patch_tokens[s.request], dtype)
+        if toks.shape[0] != s.length - k:
+            raise ValueError(
+                f"request {s.request}: planned {s.length - k} patch tokens, "
+                f"got {toks.shape[0]}"
+            )
+        buf[s.row, s.offset + k : s.offset + s.length] = toks
+    return buf
+
+
+def unpack_rows(plan: PackPlan, packed_out: np.ndarray) -> list[np.ndarray]:
+    """Gather each request's per-segment output from a ``(rows,
+    max_segments, ...)`` device result, back in request order."""
+    out: list = [None] * len(plan.segments)
+    for s in plan.segments:
+        out[s.request] = np.asarray(packed_out[s.row, s.slot])
+    return out

@@ -57,6 +57,7 @@ weights at the first failure:
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -98,10 +99,10 @@ class _Request:
 
     __slots__ = (
         "image", "meta", "deadline", "fut", "tr", "excluded",
-        "retries", "t0", "_settled", "_lock",
+        "retries", "t0", "gid", "_settled", "_lock",
     )
 
-    def __init__(self, image, meta, deadline, fut, tr):
+    def __init__(self, image, meta, deadline, fut, tr, gid=None):
         self.image = image
         self.meta = meta
         self.deadline = deadline
@@ -110,6 +111,10 @@ class _Request:
         self.excluded: set[str] = set()
         self.retries = 0
         self.t0 = time.perf_counter()
+        # dispatch-group id (submit_group): the worker only coalesces
+        # requests sharing a gid, so a group the scheduler shaped flushes
+        # exactly as shaped — never merged with a neighboring group
+        self.gid = gid
         self._settled = False
         self._lock = lockwatch.lock("replicaset.request")
 
@@ -308,6 +313,7 @@ class ReplicaSet:
         self._depth = 0
         self._submitted = 0
         self._shed_n = 0
+        self._gid = itertools.count(1)  # dispatch-group ids (submit_group)
         self._depth_lock = lockwatch.lock("replicaset.depth")
         self._live: set[_Request] = set()
         self._live_lock = lockwatch.lock("replicaset.live")
@@ -471,11 +477,14 @@ class ReplicaSet:
                     self._tracer.finish(tr, outcome, error=err)
             raise
         recs = []
+        gid = next(self._gid)
         for image, deadline, meta, tr in items:
             fut: Future = Future()
             if tr is not None:
                 fut.rid = tr.rid
-            recs.append(_Request(np.asarray(image), meta, deadline, fut, tr))
+            recs.append(
+                _Request(np.asarray(image), meta, deadline, fut, tr, gid=gid)
+            )
         with self._live_lock:
             self._live.update(recs)
         for rec in recs:
@@ -919,35 +928,57 @@ class ReplicaSet:
         return rep.idx >= len(self._slots) or self._slots[rep.idx] is not rep
 
     def _worker(self, rep: _Replica) -> None:
-        while not self._stale(rep):
-            try:
-                item = rep.q.get(timeout=0.05)
-            except queue.Empty:
-                if self._closed:
+        carry: _Request | None = None  # lookahead from a different group
+        try:
+            while not self._stale(rep):
+                if carry is not None:
+                    item, carry = carry, None
+                else:
+                    try:
+                        item = rep.q.get(timeout=0.05)
+                    except queue.Empty:
+                        if self._closed:
+                            return
+                        continue
+                    if item is _STOP:
+                        return
+                batch: list[_Request] = []
+                self._admit(item, batch)
+                coalesce_deadline = time.monotonic() + self.max_delay
+                stop = False
+                while len(batch) < self.max_batch:
+                    remaining = coalesce_deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = rep.q.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                    if nxt is _STOP:
+                        stop = True
+                        break
+                    # dispatch groups flush exactly as shaped: never
+                    # coalesce across a group boundary (the scheduler
+                    # already sized the group to its image or token
+                    # budget — merging would blow past it and, with mixed
+                    # shapes, break batch stacking). batch may be empty
+                    # here when _admit dropped the opener.
+                    if batch and nxt.gid != batch[0].gid:
+                        carry = nxt
+                        break
+                    self._admit(nxt, batch)
+                if batch and not self._flush(rep, batch):
+                    return  # crashed: restart is the supervisor's job now
+                if stop:
                     return
-                continue
-            if item is _STOP:
-                return
-            batch: list[_Request] = []
-            self._admit(item, batch)
-            coalesce_deadline = time.monotonic() + self.max_delay
-            stop = False
-            while len(batch) < self.max_batch:
-                remaining = coalesce_deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = rep.q.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if nxt is _STOP:
-                    stop = True
-                    break
-                self._admit(nxt, batch)
-            if batch and not self._flush(rep, batch):
-                return  # crashed: restart is the supervisor's job now
-            if stop:
-                return
+        finally:
+            if carry is not None:
+                # the worker is exiting (crash/stop/stale) while holding a
+                # lookahead record that was never admitted: route it back
+                # through the requeue path so it can't hang until close()
+                with self._depth_lock:
+                    self._depth -= 1
+                self._requeue(carry, rep, "worker exited holding lookahead")
 
     def _admit(self, rec: _Request, batch: list) -> None:
         with self._depth_lock:
@@ -993,8 +1024,14 @@ class ReplicaSet:
         t_run = time.perf_counter()
         try:
             fault_point("serve.replica", key=rep.name)
-            stacked = np.stack([rec.image for rec in batch])
-            out = self._run(rep.engine, stacked, [rec.meta for rec in batch])
+            # a token-packed group mixes resolutions: no common stack shape,
+            # so the run_fn gets the raw image list (predict_packed takes
+            # per-request arrays; the homogeneous fast path keeps the stack)
+            if len({rec.image.shape for rec in batch}) == 1:
+                images = np.stack([rec.image for rec in batch])
+            else:
+                images = [rec.image for rec in batch]
+            out = self._run(rep.engine, images, [rec.meta for rec in batch])
         except BaseException as e:  # noqa: BLE001 — crash-isolate the replica
             rep.busy_since = None
             rep.pending = ()

@@ -71,6 +71,21 @@ def resolve_attn_impl(
 ConfigT = Any  # JumboViTConfig | DecoderConfig — same attribute surface
 
 
+def segment_attention_mask(segment_ids: jax.Array) -> jax.Array:
+    """Block-diagonal attention mask for token-packed sequences.
+
+    ``segment_ids`` is (batch, seq) int32 — ``slot+1`` on tokens a packed
+    segment owns, 0 on padding. A position attends only within its own
+    segment (``same id AND id > 0``); the diagonal is OR'd in so all-pad
+    positions softmax over themselves instead of an all(-inf) row whose
+    NaN would pollute valid rows through the probs·V matmul. Returns
+    (batch, 1, seq, seq) bool, broadcast over heads."""
+    s = segment_ids
+    same = (s[:, :, None] == s[:, None, :]) & (s[:, :, None] > 0)
+    eye = jnp.eye(s.shape[-1], dtype=bool)[None]
+    return (same | eye)[:, None, :, :]
+
+
 class Attention(nn.Module):
     """Multi-head self-attention.
 
@@ -89,7 +104,12 @@ class Attention(nn.Module):
     cfg: ConfigT
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        deterministic: bool = True,
+        mask: jax.Array | None = None,
+    ) -> jax.Array:
         cfg = self.cfg
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
             (cfg.heads, cfg.head_dim),
@@ -101,6 +121,16 @@ class Attention(nn.Module):
         k = dense("k")(x)
         v = dense("v")(x)
 
+        # Masked attention (token-packed serving's block-diagonal segment
+        # mask) exists only on the einsum path: the flash/ring kernels take
+        # no mask operand, and silently dropping one would leak tokens
+        # across segments.
+        if mask is not None and cfg.attn_impl in ("flash", "ring"):
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r} has no attention-mask "
+                "support; packed/masked attention requires the einsum path "
+                "(attn_impl='einsum' or 'auto')"
+            )
         # The flash/ring paths have no attention-probability dropout; any
         # dropout>0 must take the einsum path so training semantics don't
         # silently change.
@@ -123,6 +153,8 @@ class Attention(nn.Module):
             dropout=cfg.dropout,
             deterministic=deterministic,
         )
+        if mask is not None:
+            impl = "einsum"  # auto: the only mask-capable path
 
         # z_head_major tracks each branch's output layout: (B,H,S,D) for the
         # einsum path, (B,S,H,D) for flash/ring — set alongside z so a new
@@ -152,7 +184,14 @@ class Attention(nn.Module):
             # materialized rounding is bf16; with float32 compute (all
             # parity tests/oracles) the path is exact and unchanged.
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+            scores = logits.astype(jnp.float32)
+            if mask is not None:
+                # -inf before softmax underflows to an exact 0 probability:
+                # a masked key contributes exactly 0·v, so segment isolation
+                # is bit-exact, not approximate (every query keeps at least
+                # its diagonal, so no row is all -inf)
+                scores = jnp.where(mask, scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1).astype(
                 cfg.compute_dtype
             )
             probs = nn.Dropout(cfg.dropout)(probs, deterministic)
@@ -264,13 +303,27 @@ class JumboBlock(nn.Module):
     Quirk preserved on purpose (training dynamics depend on it): the CLS
     residual base is the *post-norm* vector — ``cc = ln3(concat);
     cc = cc + dp(ls3 · jumbo_mlp(cc))`` — not the pre-norm input.
+
+    ``packed`` (positional, a traced pytree — stays past the remat
+    wrapper's static ``deterministic`` slot) switches the block to
+    token-packed layout: attention takes the block-diagonal segment mask,
+    and the CLS tokens live at each segment's ``cls_index`` offsets
+    instead of the sequence head. The per-segment math is identical —
+    gather the k CLS tokens, same ln3/jumbo_mlp/residual, scatter back —
+    so a packed segment computes exactly what its unpacked batch row
+    would (the parity tests' contract).
     """
 
     cfg: JumboViTConfig
     jumbo_mlp: nn.Module
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        deterministic: bool = True,
+        packed: dict | None = None,
+    ) -> jax.Array:
         cfg = self.cfg
         k = cfg.num_cls_tokens
         ls = (
@@ -280,31 +333,66 @@ class JumboBlock(nn.Module):
         )
 
         h = Attention(cfg, name="attn")(
-            nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x), deterministic
+            nn.LayerNorm(dtype=cfg.compute_dtype, name="ln1")(x),
+            deterministic,
+            mask=None if packed is None else packed["mask"],
         )
         x = x + DropPath(cfg.droppath, name="dp1")(
             ls("ls1", cfg.dim) * h, deterministic
         )
 
-        cls, patches = x[:, :k, :], x[:, k:, :]
-        bs = cls.shape[0]
+        if packed is None:
+            cls, patches = x[:, :k, :], x[:, k:, :]
+            bs = cls.shape[0]
 
-        cc = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln3")(
-            cls.reshape(bs, k * cfg.dim)
-        )
+            cc = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln3")(
+                cls.reshape(bs, k * cfg.dim)
+            )
+            cc = cc + DropPath(cfg.droppath, name="dp3")(
+                ls("ls3", k * cfg.dim) * self.jumbo_mlp(cc, deterministic),
+                deterministic,
+            )
+
+            h = Mlp(
+                cfg.dim, cfg.hidden_dim, cfg.dropout, cfg.compute_dtype, name="mlp"
+            )(nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(patches), deterministic)
+            patches = patches + DropPath(cfg.droppath, name="dp2")(
+                ls("ls2", cfg.dim) * h, deterministic
+            )
+
+            return jnp.concatenate([cc.reshape(bs, k, cfg.dim), patches], axis=1)
+
+        # ---- packed layout: (rows, budget, dim) with per-segment CLS ----
+        rows, seq, dim = x.shape
+        cls_index = packed["cls_index"]  # (rows, max_segments, k)
+        smax = cls_index.shape[1]
+        # gather each slot's k CLS tokens -> the same (k·dim) concat the
+        # unpacked branch builds from the sequence head
+        g = jnp.take_along_axis(
+            x, cls_index.reshape(rows, smax * k)[..., None], axis=1
+        ).reshape(rows, smax, k * cfg.dim)
+        cc = nn.LayerNorm(dtype=cfg.compute_dtype, name="ln3")(g)
         cc = cc + DropPath(cfg.droppath, name="dp3")(
             ls("ls3", k * cfg.dim) * self.jumbo_mlp(cc, deterministic),
             deterministic,
         )
 
+        # patch MLP over ALL positions (it is per-token, so computing it on
+        # CLS/pad positions is inert — CLS positions are overwritten below
+        # and pads are never read through the masked attention)
         h = Mlp(
             cfg.dim, cfg.hidden_dim, cfg.dropout, cfg.compute_dtype, name="mlp"
-        )(nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(patches), deterministic)
-        patches = patches + DropPath(cfg.droppath, name="dp2")(
+        )(nn.LayerNorm(dtype=cfg.compute_dtype, name="ln2")(x), deterministic)
+        patches = x + DropPath(cfg.droppath, name="dp2")(
             ls("ls2", cfg.dim) * h, deterministic
         )
 
-        return jnp.concatenate([cc.reshape(bs, k, cfg.dim), patches], axis=1)
+        # scatter the updated CLS back to their in-row positions
+        cc4 = cc.reshape(rows, smax, k, cfg.dim)
+        slot0 = jnp.clip(packed["segment_ids"] - 1, 0)  # (rows, seq)
+        pos0 = jnp.clip(packed["cls_pos"], 0)
+        cls_vals = cc4[jnp.arange(rows)[:, None], slot0, pos0]
+        return jnp.where(packed["cls_pos"][..., None] >= 0, cls_vals, patches)
 
 
 class PatchEmbed(nn.Module):

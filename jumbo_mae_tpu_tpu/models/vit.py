@@ -33,6 +33,7 @@ from jumbo_mae_tpu_tpu.models.layers import (
     JumboBlock,
     PatchEmbed,
     make_jumbo_mlp,
+    segment_attention_mask,
 )
 from jumbo_mae_tpu_tpu.ops.masking import random_masking
 
@@ -131,3 +132,138 @@ class JumboViT(nn.Module):
 
         pooled = pool_tokens(x, k, cfg.pooling)
         return self.head(pooled.astype(jnp.float32), deterministic)
+
+    # ------------------------------------------------- token-packed serving
+
+    def patchify(self, images: jax.Array) -> jax.Array:
+        """Patch embedding only (conv + posemb), (B, N, dim) — the packed
+        serving path embeds each request at its own resolution, then packs
+        the resulting token segments into one buffer. CLS tokens are NOT
+        prepended here: the positional embedding applies to patches only
+        in this architecture, so CLS injection can happen inside the packed
+        executable (see :meth:`encode_packed`) with identical numerics."""
+        return self.embed(images)
+
+    def encode_packed(
+        self,
+        tokens: jax.Array,
+        segment_ids: jax.Array,
+        cls_pos: jax.Array,
+        cls_index: jax.Array,
+        deterministic: bool = True,
+    ) -> jax.Array:
+        """Run the block stack over a token-packed buffer.
+
+        ``tokens`` is (rows, budget, dim) — already patch-embedded, zeros
+        at CLS slots and padding. ``segment_ids``/``cls_pos``/``cls_index``
+        are the :mod:`~jumbo_mae_tpu_tpu.infer.packing` plan arrays. The
+        CLS parameter is injected at each segment's ``cls_pos`` slots;
+        attention is block-diagonal per segment; every other op is
+        per-token — so each segment computes exactly what its own unpacked
+        batch row would."""
+        cfg = self.cfg
+        x = tokens.astype(cfg.compute_dtype)
+        cls = jnp.asarray(self.cls_tokens, x.dtype)[0]  # (k, dim)
+        x = jnp.where(cls_pos[..., None] >= 0, cls[jnp.clip(cls_pos, 0)], x)
+        x = self.drop(x, deterministic)
+        packed = {
+            "mask": segment_attention_mask(segment_ids),
+            "segment_ids": segment_ids,
+            "cls_pos": cls_pos,
+            "cls_index": cls_index,
+        }
+        for block in self.blocks:
+            x = block(x, deterministic, packed)
+        return self.norm(x)
+
+    def pool_packed(
+        self,
+        tokens: jax.Array,
+        segment_ids: jax.Array,
+        cls_pos: jax.Array,
+        cls_index: jax.Array,
+        pooling: str = "cls",
+    ) -> jax.Array:
+        """Per-segment :func:`pool_tokens`: (rows, max_segments, k·dim)
+        for ``"cls"``, (rows, max_segments, dim) for ``"gap"``. Unoccupied
+        slots pool garbage (slot 0's tokens / zero counts clamped to 1) —
+        callers slice results by the pack plan, so those never escape."""
+        cfg = self.cfg
+        k = cfg.num_cls_tokens
+        rows, _, dim = tokens.shape
+        smax = cls_index.shape[1]
+        if pooling == "gap":
+            slot = jnp.arange(1, smax + 1, dtype=segment_ids.dtype)
+            own = (segment_ids[:, None, :] == slot[None, :, None]) & (
+                cls_pos[:, None, :] < 0
+            )
+            w = own.astype(tokens.dtype)
+            sums = jnp.einsum("rsl,rld->rsd", w, tokens)
+            counts = jnp.maximum(w.sum(axis=-1), 1.0)
+            return sums / counts[..., None]
+        g = jnp.take_along_axis(
+            tokens, cls_index.reshape(rows, smax * k)[..., None], axis=1
+        )
+        return g.reshape(rows, smax, k * dim)
+
+    def serve_packed(
+        self,
+        tokens: jax.Array,
+        segment_ids: jax.Array,
+        cls_pos: jax.Array,
+        cls_index: jax.Array,
+        deterministic: bool = True,
+        *,
+        pooling: str = "cls",
+    ) -> dict[str, jax.Array]:
+        """The packed serving forward: encode, pool per segment, and (when
+        the model has a head) classify — ``{"pooled": ..., "logits": ...}``
+        so features and logits requests ride one executable."""
+        x = self.encode_packed(
+            tokens, segment_ids, cls_pos, cls_index, deterministic
+        )
+        pooled = self.pool_packed(x, segment_ids, cls_pos, cls_index, pooling)
+        out = {"pooled": pooled.astype(jnp.float32)}
+        if self.head is not None:
+            head_in = (
+                pooled
+                if pooling == self.cfg.pooling
+                else self.pool_packed(
+                    x, segment_ids, cls_pos, cls_index, self.cfg.pooling
+                )
+            )
+            out["logits"] = self.head(
+                head_in.astype(jnp.float32), deterministic
+            ).astype(jnp.float32)
+        return out
+
+    def serve_full(
+        self,
+        images: jax.Array,
+        deterministic: bool = True,
+        *,
+        pooling: str = "cls",
+    ) -> dict[str, jax.Array]:
+        """Unpacked mirror of :meth:`serve_packed` — same output contract
+        from a plain image batch. This is the packed path's per-request
+        parity oracle (it also serves non-native resolutions, which the
+        bucketed ``__call__`` path rejects)."""
+        cfg = self.cfg
+        k = cfg.num_cls_tokens
+        x = self.embed(images)
+        bs = x.shape[0]
+        cls = jnp.broadcast_to(
+            jnp.asarray(self.cls_tokens, x.dtype), (bs, k, cfg.dim)
+        )
+        x = jnp.concatenate([cls, x], axis=1)
+        x = self.drop(x, deterministic)
+        for block in self.blocks:
+            x = block(x, deterministic)
+        x = self.norm(x)
+        out = {"pooled": pool_tokens(x, k, pooling).astype(jnp.float32)}
+        if self.head is not None:
+            head_in = pool_tokens(x, k, cfg.pooling)
+            out["logits"] = self.head(
+                head_in.astype(jnp.float32), deterministic
+            ).astype(jnp.float32)
+        return out

@@ -59,7 +59,7 @@ class RequestTrace:
         "queue_wait_s", "admission_s", "compute_s", "fetch_s",
         "batch", "bucket", "pad_fraction", "latency_s", "outcome", "error",
         "replica_id", "retries", "requeued_from", "tenant", "tclass",
-        "device_s", "cost_flops",
+        "device_s", "cost_flops", "tokens",
     )
 
     def __init__(
@@ -94,6 +94,9 @@ class RequestTrace:
         self.requeued_from = None
         self.device_s = None
         self.cost_flops = None
+        # patch+CLS token count, stamped by the packed scheduler path —
+        # the costmeter bills device time token-pro-rata when present
+        self.tokens = None
 
 
 class AccessLog:
@@ -277,6 +280,7 @@ class RequestTracer:
                 ("batch", tr.batch),
                 ("bucket", tr.bucket),
                 ("pad", tr.pad_fraction),
+                ("tokens", tr.tokens),
                 ("device_ms", _ms(tr.device_s)),
                 ("cost_flops", tr.cost_flops),
                 ("deadline_ms", tr.deadline_ms),

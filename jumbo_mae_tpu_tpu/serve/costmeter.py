@@ -234,6 +234,14 @@ class CostMeter:
         exec_flops = _cost_field(cost, "flops")
         exec_bytes = _cost_field(cost, "bytes_accessed")
 
+        # token-packed groups carry per-trace token counts: a 896px request
+        # in the pack did ~49x the work of a 224px one, so uniform per-row
+        # split would cross-subsidize. Token-pro-rata shares preserve the
+        # conservation law (per-trace sums still equal batch totals).
+        tok = [float(getattr(tr, "tokens", None) or 0) for tr in traces]
+        tok_total = sum(tok)
+        token_weighted = tok_total > 0 and all(t > 0 for t in tok)
+
         row_s = run_s / n
         row_flops = exec_flops / n
         row_bytes = exec_bytes / n
@@ -246,7 +254,14 @@ class CostMeter:
             self.total_device_s += run_s
             self.total_flops += exec_flops
             seen: set[str] = set()
-            for tr in traces:
+            for j, tr in enumerate(traces):
+                if token_weighted:
+                    share = tok[j] / tok_total
+                    row_s = run_s * share
+                    row_flops = exec_flops * share
+                    row_bytes = exec_bytes * share
+                    waste_s_per_trace = run_s * pad * share
+                    waste_flops_per_trace = exec_flops * pad * share
                 tr.device_s = row_s
                 tr.cost_flops = row_flops if row_flops > 0.0 else None
                 tenant = getattr(tr, "tenant", None) or "_default"

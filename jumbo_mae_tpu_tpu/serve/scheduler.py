@@ -48,6 +48,7 @@ from jumbo_mae_tpu_tpu.infer.batching import (
     QueueFullError,
     ShutdownError,
 )
+from jumbo_mae_tpu_tpu.infer.bucketing import floor_bucket  # noqa: F401 — re-export
 from jumbo_mae_tpu_tpu.obs import lockwatch
 from jumbo_mae_tpu_tpu.obs.metrics import RATIO_BUCKETS, get_registry
 from jumbo_mae_tpu_tpu.serve.admission import CLASSES, CLASS_WEIGHT
@@ -59,25 +60,16 @@ _STOP = object()
 _DEADLINE_MARGIN = 0.25
 
 
-def floor_bucket(k: int, max_batch: int) -> int:
-    """Largest engine pad-bucket size <= k: the engine pads every flush up
-    to a power-of-2 bucket (capped at max_batch, itself the top rung), so
-    a batch of exactly this size runs with zero pad rows."""
-    if k >= max_batch:
-        return max_batch
-    b = 1
-    while b * 2 <= k:
-        b *= 2
-    return b
-
-
 class _Entry:
     __slots__ = (
         "image", "fut", "tr", "tenant", "tclass", "deadline",
-        "meta", "task", "t_submit",
+        "meta", "task", "t_submit", "tokens",
     )
 
-    def __init__(self, image, fut, tr, tenant, tclass, deadline, meta, task, now):
+    def __init__(
+        self, image, fut, tr, tenant, tclass, deadline, meta, task, now,
+        tokens=None,
+    ):
         self.image = image
         self.fut = fut
         self.tr = tr
@@ -87,6 +79,7 @@ class _Entry:
         self.meta = meta
         self.task = task
         self.t_submit = now
+        self.tokens = tokens       # packed mode: patch+CLS token count
 
 
 class ContinuousScheduler:
@@ -101,6 +94,15 @@ class ContinuousScheduler:
     (pending / ``max_queue``), wire ``admission.pressure_fn`` to
     :meth:`pressure`. ``clock`` must be ``time.monotonic``-like (absolute
     deadlines are compared against it).
+
+    ``packed=True`` switches the accumulators from per-``(task, shape)``
+    to ONE token accumulator: mixed resolutions and encoder-sharing tasks
+    coalesce together, a batch fills when its *token* sum reaches
+    ``token_budget`` (``seq_len_fn(image) -> tokens`` prices each entry),
+    and the dispatch backend is expected to serve the group through the
+    engine's token-packed path (``predict_packed``). Entries carry their
+    token count on their trace (``tr.tokens``) so the costmeter bills
+    device time token-pro-rata instead of per-row.
     """
 
     def __init__(
@@ -115,9 +117,26 @@ class ContinuousScheduler:
         task: str = "",
         registry=None,
         clock=time.monotonic,
+        packed: bool = False,
+        token_budget: int | None = None,
+        seq_len_fn=None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if packed:
+            if seq_len_fn is None:
+                raise ValueError(
+                    "packed=True needs seq_len_fn (e.g. lambda img: "
+                    "engine.seq_len(img.shape[0])) to price entries in tokens"
+                )
+            if not token_budget or token_budget < 1:
+                raise ValueError(
+                    f"packed=True needs a positive token_budget, got "
+                    f"{token_budget}"
+                )
+        self.packed = bool(packed)
+        self.token_budget = int(token_budget) if token_budget else None
+        self._seq_len_fn = seq_len_fn
         self._dispatch = dispatch
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1000.0
@@ -195,10 +214,22 @@ class ContinuousScheduler:
             else None
         )
         arr = np.asarray(image)
+        tokens = None
         try:
             fault_point("serve.submit")
             if self._closed:
                 raise ShutdownError("ContinuousScheduler is closed")
+            if self._seq_len_fn is not None:
+                # price the entry in tokens up front — a misaligned or
+                # oversized request sheds here, typed, not on the dispatcher.
+                # A seq_len_fn without packed mode still stamps tr.tokens so
+                # the costmeter can bill image-bucketed traffic pro-rata too
+                tokens = int(self._seq_len_fn(arr))
+                if self.packed and tokens > self.token_budget:
+                    raise ValueError(
+                        f"request needs {tokens} tokens > token_budget="
+                        f"{self.token_budget} — raise the budget or resize"
+                    )
             if self.admission is not None:
                 self.admission.admit(tenant)
             with self._depth_lock:
@@ -234,8 +265,10 @@ class ContinuousScheduler:
         )
         entry = _Entry(
             arr, fut, tr, tenant, tclass, deadline, meta,
-            task if task is not None else self.task, now,
+            task if task is not None else self.task, now, tokens,
         )
+        if tr is not None and tokens is not None:
+            tr.tokens = tokens
         self._wake.put(entry)
         return fut
 
@@ -286,6 +319,14 @@ class ContinuousScheduler:
             cut = min(cut, entry.deadline - _DEADLINE_MARGIN * self.max_delay)
         return cut
 
+    def _key(self, entry: _Entry) -> tuple:
+        """Accumulator key: per-(task, shape) bucketed, ONE shared token
+        accumulator packed — mixing resolutions and encoder-sharing tasks
+        is the whole point of the packed dispatch."""
+        if self.packed:
+            return ("__packed__",)
+        return (entry.task, entry.image.shape)
+
     def _loop(self) -> None:
         # all accumulator state lives on this thread — no locks
         buckets: dict[tuple, list[_Entry]] = {}
@@ -299,8 +340,7 @@ class ContinuousScheduler:
                 self._shutdown(buckets)
                 return
             if item is not None:
-                key = (item.task, item.image.shape)
-                buckets.setdefault(key, []).append(item)
+                buckets.setdefault(self._key(item), []).append(item)
                 # opportunistic drain: pull everything already queued so a
                 # burst lands in its accumulators in one pass
                 while True:
@@ -311,8 +351,7 @@ class ContinuousScheduler:
                     if nxt is _STOP:
                         self._shutdown(buckets)
                         return
-                    key = (nxt.task, nxt.image.shape)
-                    buckets.setdefault(key, []).append(nxt)
+                    buckets.setdefault(self._key(nxt), []).append(nxt)
             self._expire(buckets)
             self._dispatch_ready(buckets)
             self._m_depth.set(sum(len(v) for v in buckets.values()))
@@ -355,11 +394,19 @@ class ContinuousScheduler:
             for key, entries in buckets.items():
                 if not entries:
                     continue
-                full = len(entries) >= self.max_batch
+                if self.packed:
+                    tok = sum(e.tokens or 0 for e in entries)
+                    full = (
+                        tok >= self.token_budget
+                        or len(entries) >= self.max_batch
+                    )
+                    occ = min(tok / self.token_budget, 1.0)
+                else:
+                    full = len(entries) >= self.max_batch
+                    occ = min(len(entries) / self.max_batch, 1.0)
                 past_cutoff = any(self._cutoff(e) <= now for e in entries)
                 if not (full or past_cutoff):
                     continue
-                occ = min(len(entries) / self.max_batch, 1.0)
                 oldest = max(now - e.t_submit for e in entries)
                 weight = max(
                     CLASS_WEIGHT.get(e.tclass, CLASS_WEIGHT["batch"])
@@ -390,6 +437,8 @@ class ContinuousScheduler:
         more entries are past cutoff than the floor bucket holds, the
         whole accumulator flushes padded.
         """
+        if self.packed:
+            return self._take_packed(entries, reason)
         n = min(len(entries), self.max_batch)
         if reason == "cutoff" and len(entries) < self.max_batch:
             now = self._clock()
@@ -428,13 +477,58 @@ class ContinuousScheduler:
         entries[:] = [e for i, e in enumerate(entries) if i not in chosen]
         return batch, reason
 
+    def _take_packed(
+        self, entries: list[_Entry], reason: str
+    ) -> tuple[list[_Entry], str]:
+        """Fill the token budget greedily in priority order: due entries
+        first (a cutoff flush must carry everyone whose delay budget is
+        spent), then class rank, then arrival. An entry that would
+        overflow the remaining budget is SKIPPED, not a wall — smaller
+        entries behind it may still top up the rung (that remainder is
+        pure pad otherwise). Starvation is bounded: the head of the order
+        is always taken, so a skipped large request reaches the head and
+        ships first in a later dispatch; each skip-over also counts into
+        ``serve_sched_priority_jumps_total``."""
+        now = self._clock()
+        rank = {c: i for i, c in enumerate(CLASSES)}
+        order = sorted(
+            range(len(entries)),
+            key=lambda i: (
+                0 if self._cutoff(entries[i]) <= now else 1,
+                rank.get(entries[i].tclass, rank["batch"]),
+                entries[i].t_submit,
+            ),
+        )
+        chosen: list[int] = []
+        tok = 0
+        for i in order:
+            if chosen and len(chosen) >= self.max_batch:
+                break
+            t = entries[i].tokens or 0
+            if chosen and tok + t > self.token_budget:
+                continue  # skim: later, smaller entries may still fit
+            chosen.append(i)
+            tok += t
+        chosen_set = set(chosen)
+        jumps = len(chosen_set - set(range(len(chosen))))
+        if jumps:
+            self._m_jumps.inc(jumps)
+        batch = [entries[i] for i in sorted(chosen_set)]
+        entries[:] = [e for i, e in enumerate(entries) if i not in chosen_set]
+        return batch, reason
+
     def _dispatch_bucket(self, buckets, key, reason: str) -> None:
         batch, reason = self._take_batch(buckets[key], reason)
         if not batch:
             return
         self._dec(len(batch))
         self._m_batches.labels(reason).inc()
-        self._m_occupancy.observe(len(batch) / self.max_batch)
+        if self.packed:
+            self._m_occupancy.observe(
+                min(sum(e.tokens or 0 for e in batch) / self.token_budget, 1.0)
+            )
+        else:
+            self._m_occupancy.observe(len(batch) / self.max_batch)
         self._occ.observe(len(batch))
         self._dispatched += len(batch)
         items = [(e.image, e.deadline, e.meta, e.tr) for e in batch]
@@ -471,7 +565,7 @@ class ContinuousScheduler:
                 break
             if item is _STOP:
                 continue
-            buckets.setdefault((item.task, item.image.shape), []).append(item)
+            buckets.setdefault(self._key(item), []).append(item)
         if not self._drain:
             # graceful: flush what we have, then stop
             for key in list(buckets):

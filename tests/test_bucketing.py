@@ -1,0 +1,96 @@
+"""The bucket-ladder invariants, enforced at the one shared definition.
+
+``infer/bucketing.py`` exists because three call sites (engine ceil,
+scheduler floor, loadgen report) each grew their own copy of the
+power-of-two walk and the honesty of the serving tier lives *between*
+them.  These tests property-check the pair against each other across the
+(n, max_batch) lattice, so any drift breaks here rather than silently in
+pad accounting.
+"""
+
+import pytest
+
+from jumbo_mae_tpu_tpu.infer.bucketing import (
+    OversizedBatchError,
+    bucket_for,
+    ceil_pow2,
+    floor_bucket,
+    pow2_rungs,
+)
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+class TestLadderInvariants:
+    @pytest.mark.parametrize("max_batch", [1, 2, 3, 4, 7, 8, 16, 24, 64])
+    def test_floor_k_ceil_sandwich(self, max_batch):
+        # floor(k) <= k <= ceil(k) for every k the ladder serves
+        for k in range(1, max_batch + 1):
+            lo = floor_bucket(k, max_batch)
+            hi = bucket_for(k, max_batch)
+            assert lo <= k <= hi, (k, max_batch, lo, hi)
+
+    @pytest.mark.parametrize("max_batch", [1, 2, 4, 8, 16, 24, 64])
+    def test_floor_is_pad_free(self, max_batch):
+        # a floor-aligned batch must pad to itself: ceil(floor(k)) == floor(k)
+        for k in range(1, 4 * max_batch):
+            lo = floor_bucket(k, max_batch)
+            assert bucket_for(lo, max_batch) == lo, (k, max_batch, lo)
+
+    @pytest.mark.parametrize("max_batch", [2, 4, 8, 16, 24])
+    def test_ceil_is_pow2_or_top_rung(self, max_batch):
+        for k in range(1, max_batch + 1):
+            b = bucket_for(k, max_batch)
+            assert _is_pow2(b) or b == max_batch
+
+    def test_oversized_raises_typed(self):
+        with pytest.raises(OversizedBatchError):
+            bucket_for(9, 8)
+        # the typed error is still a ValueError for legacy handlers
+        with pytest.raises(ValueError):
+            bucket_for(17, 16)
+
+    def test_nonpositive_raises(self):
+        with pytest.raises(ValueError):
+            bucket_for(0, 8)
+        with pytest.raises(ValueError):
+            bucket_for(-3, 8)
+
+    def test_non_pow2_max_batch_is_the_top_rung(self):
+        # 24 is not a power of two: 17..24 all land on 24, never above
+        assert bucket_for(16, 24) == 16
+        for k in range(17, 25):
+            assert bucket_for(k, 24) == 24
+        assert floor_bucket(24, 24) == 24
+        assert floor_bucket(100, 24) == 24
+
+
+class TestPow2Helpers:
+    def test_ceil_pow2_values(self):
+        assert [ceil_pow2(n) for n in (1, 2, 3, 4, 5, 8, 9, 1023)] == [
+            1, 2, 4, 4, 8, 8, 16, 1024,
+        ]
+
+    def test_ceil_pow2_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            ceil_pow2(0)
+
+    def test_pow2_rungs_pow2_max(self):
+        assert pow2_rungs(16) == (1, 2, 4, 8, 16)
+
+    def test_pow2_rungs_appends_non_pow2_max(self):
+        assert pow2_rungs(24) == (1, 2, 4, 8, 16, 24)
+        assert pow2_rungs(1) == (1,)
+
+    def test_pow2_rungs_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            pow2_rungs(0)
+
+    @pytest.mark.parametrize("mv", [1, 2, 7, 8, 100, 4096])
+    def test_rungs_cover_every_need(self, mv):
+        # any n <= max_value has a rung >= n (choose_budget relies on this)
+        rungs = pow2_rungs(mv)
+        for n in range(1, mv + 1):
+            assert any(b >= n for b in rungs)

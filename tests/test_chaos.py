@@ -210,10 +210,31 @@ def _params_equal(a, b) -> bool:
 
 
 class TestDeviceGuard:
-    def test_nan_loss_step_is_skipped(self):
+    # One compiled setup per guard flavor for the whole class — the ~10 s
+    # XLA compile is paid once instead of per test. The train step DONATES
+    # its input state, so the fixture keeps a pristine host snapshot and
+    # hands every caller a fresh device copy via fresh().
+    @staticmethod
+    def _shared(guard):
+        import jax
+
+        state, step, batch = _tiny_train_setup(guard=guard)
+        snap = jax.device_get(state)
+        return (lambda: jax.device_put(snap)), step, batch
+
+    @pytest.fixture(scope="class")
+    def guarded(self):
+        return self._shared(guard=True)
+
+    @pytest.fixture(scope="class")
+    def unguarded(self):
+        return self._shared(guard=False)
+
+    def test_nan_loss_step_is_skipped(self, guarded):
         """Injected NaN loss: params bit-unchanged, step still advances,
         skipped flag raised; the same batch applies cleanly afterwards."""
-        state, step_fn, batch = _tiny_train_setup(guard=True)
+        fresh, step_fn, batch = guarded
+        state = fresh()
         p0 = _host_params(state)
         s0 = int(state.step)
 
@@ -230,8 +251,9 @@ class TestDeviceGuard:
         assert math.isfinite(float(metrics["grad_norm"]))
         assert not _params_equal(p0, _host_params(state))
 
-    def test_nan_grad_step_is_skipped(self):
-        state, step_fn, batch = _tiny_train_setup(guard=True)
+    def test_nan_grad_step_is_skipped(self, guarded):
+        fresh, step_fn, batch = guarded
+        state = fresh()
         p0 = _host_params(state)
         state, metrics = step_fn(
             state, batch, np.asarray([1.0, np.nan], np.float32)
@@ -239,9 +261,10 @@ class TestDeviceGuard:
         assert float(metrics["skipped"]) == 1.0
         assert _params_equal(p0, _host_params(state))
 
-    def test_unguarded_nan_poisons_params(self):
+    def test_unguarded_nan_poisons_params(self, unguarded):
         """The counterfactual the guard exists for."""
-        state, step_fn, batch = _tiny_train_setup(guard=False)
+        fresh, step_fn, batch = unguarded
+        state = fresh()
         state, _ = step_fn(state, batch, np.asarray([np.nan, 1.0], np.float32))
         import jax
 
@@ -251,13 +274,14 @@ class TestDeviceGuard:
         )
         assert any_nan
 
-    def test_guard_off_matches_pre_guard_numerics(self):
+    def test_guard_off_matches_pre_guard_numerics(self, unguarded):
         """inject=None (the default every existing caller uses) multiplies
-        by exactly 1.0 — bit-identical to the pre-injection step."""
-        state_a, step_a, batch = _tiny_train_setup(guard=False)
-        sa, ma = step_a(state_a, batch)
-        state_b, step_b, _ = _tiny_train_setup(guard=False)
-        sb, mb = step_b(state_b, batch, np.ones(2, np.float32))
+        by exactly 1.0 — bit-identical to the pre-injection step. Both legs
+        start from value-identical initial states, so any difference is the
+        injection multiply itself."""
+        fresh, step_fn, batch = unguarded
+        sa, ma = step_fn(fresh(), batch)
+        sb, mb = step_fn(fresh(), batch, np.ones(2, np.float32))
         assert float(ma["loss"]) == float(mb["loss"])
         assert _params_equal(_host_params(sa), _host_params(sb))
 
@@ -726,9 +750,13 @@ def test_sigterm_checkpoint_and_resume_inprocess(tmp_path, capsys):
     a resume run continues from exactly that step to completion."""
     from jumbo_mae_tpu_tpu.cli.train import train
 
-    total = 400
+    # 24 steps, not hundreds: the contract is SIGTERM-at-step>=3 →
+    # checkpoint → resume-to-completion, and post-compile smoke steps are
+    # ~150 ms each on the 1-core CI box — any larger total only burns the
+    # tier-1 wall-clock budget without widening coverage.
+    total = 24
     overrides = _smoke_overrides(
-        tmp_path, total, ["run.eval_interval=100000", "run.log_interval=50"]
+        tmp_path, total, ["run.eval_interval=100000", "run.log_interval=8"]
     )
     cfg = load_config(RECIPES / "smoke_cpu.yaml", overrides)
 

@@ -478,3 +478,54 @@ def test_cost_conservation_under_replica_crash_faults(tmp_path, fault_plan):
     assert all(r.get("cost_flops", 0) > 0 for r in ok_rows)
     # requeued-off-r1 requests were billed once, on the surviving replica
     assert all(r.get("replica") != "r1" for r in ok_rows)
+
+
+def test_observe_batch_token_pro_rata_split():
+    """A packed group carries per-trace token counts: the 96-token request
+    did 3x the work of each 32-token one, so time/flops/waste split by
+    token share — and the conservation law still holds exactly."""
+    meter = CostMeter(
+        parse_tenants("a=interactive,b=batch"),
+        registry=MetricsRegistry(),
+        cost_fn=lambda eng, task, bucket: {"flops": 1600.0},
+    )
+    traces = [
+        _trace(0, "a", "interactive", bucket=160, pad=0.2),
+        _trace(1, "b", "batch", bucket=160, pad=0.2),
+        _trace(2, "b", "batch", bucket=160, pad=0.2),
+    ]
+    traces[0].tokens = 96
+    traces[1].tokens = 32
+    traces[2].tokens = 32
+    meter.observe_batch(run_s=0.8, traces=traces, batch=3)
+    snap = meter.snapshot()
+    a, b = snap["tenants"]["a"], snap["tenants"]["b"]
+    # 96/160 of the wall time to a, 64/160 to b — not an equal thirds split
+    assert a["device_s"] == pytest.approx(0.8 * 96 / 160)
+    assert b["device_s"] == pytest.approx(0.8 * 64 / 160)
+    assert a["device_s"] + b["device_s"] == pytest.approx(0.8)
+    assert a["flops"] == pytest.approx(1600.0 * 96 / 160)
+    assert a["flops"] + b["flops"] == pytest.approx(1600.0)
+    # waste (run_s x pad) splits by the same shares
+    waste = a["waste_device_s"] + b["waste_device_s"]
+    assert waste == pytest.approx(0.8 * 0.2)
+    assert a["waste_device_s"] == pytest.approx(waste * 96 / 160)
+
+
+def test_observe_batch_partial_tokens_falls_back_to_uniform():
+    """Any trace missing its token count disables the token split for the
+    whole group — a half-priced group would break conservation."""
+    meter = CostMeter(
+        parse_tenants("a=interactive,b=batch"),
+        registry=MetricsRegistry(),
+        cost_fn=None,
+    )
+    traces = [
+        _trace(0, "a", "interactive", bucket=2, pad=0.0),
+        _trace(1, "b", "batch", bucket=2, pad=0.0),
+    ]
+    traces[0].tokens = 96  # trace 1 has none
+    meter.observe_batch(run_s=1.0, traces=traces, batch=2)
+    snap = meter.snapshot()
+    assert snap["tenants"]["a"]["device_s"] == pytest.approx(0.5)
+    assert snap["tenants"]["b"]["device_s"] == pytest.approx(0.5)

@@ -1,0 +1,217 @@
+"""Token-packed serving contracts: the model forward and the engine path.
+
+What the packed rollout stands on (see ``infer/packing.py`` docstring):
+
+- **segment isolation is bit-exact**: with an identical pack plan,
+  perturbing one request's pixels cannot move any other segment's output
+  by a single bit — the block-diagonal mask is the only cross-token op;
+- **padding is inert**: garbage in pad token positions (segment id 0)
+  produces bit-identical pooled outputs to zero padding, and row-bucketed
+  all-pad rows change nothing;
+- **packed == unpacked**: per-request numeric parity against the plain
+  forward on the same tree, across resolutions and mixed tasks, at the
+  same thresholds the int8 quant gate uses (cosine >= 0.999, top-1 >=
+  0.98);
+- a wrong-resolution *unpacked* predict raises the typed
+  ``ResolutionMismatchError`` so a router can re-route to the packed path.
+"""
+
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.config import load_config
+from jumbo_mae_tpu_tpu.infer import InferenceEngine, ResolutionMismatchError
+from jumbo_mae_tpu_tpu.infer import packing
+from jumbo_mae_tpu_tpu.models import JumboViT, preset
+
+RECIPE_OVERRIDES = [
+    "model.overrides.dtype=float32",
+    "model.dec_layers=1",
+    "model.dec_dim=32",
+    "model.dec_heads=2",
+    "model.dec_dtype=float32",
+]
+
+
+def tiny_cfg(extra=()):
+    recipe = Path(__file__).resolve().parent.parent / "recipes" / "smoke_cpu.yaml"
+    return load_config(recipe, RECIPE_OVERRIDES + list(extra))
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # smoke recipe: 32px native, patch 4, sincos2d posemb (resolution-agile)
+    return InferenceEngine(tiny_cfg(), max_batch=8, max_tokens=512)
+
+
+@pytest.fixture(scope="module")
+def engine_labels():
+    return InferenceEngine(tiny_cfg(), max_batch=8, max_tokens=512, labels=11)
+
+
+def _images(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        rng.randint(0, 256, (s, s, 3)).astype(np.uint8) for s in sizes
+    ]
+
+
+# ------------------------------------------------- model-level inertness
+
+
+class TestPackedForward:
+    """Direct ``serve_packed`` applies on a tiny float32 JumboViT — the
+    mask/pooling properties, independent of the engine pipeline."""
+
+    @classmethod
+    def setup_class(cls):
+        cls.cfg = preset(
+            "vit_t16", image_size=32, patch_size=8, dtype="float32",
+            labels=None, posemb="sincos2d",
+        )
+        cls.model = JumboViT(cls.cfg)
+        cls.vars_ = cls.model.init(
+            {"params": jax.random.key(0)},
+            np.zeros((1, 32, 32, 3), np.float32),
+        )
+        cls.k = cls.cfg.num_cls_tokens
+
+    def _pack(self, imgs):
+        # per-resolution patchify, the way the engine's stage 1 does it:
+        # sincos2d posemb is parameter-free, so one params tree serves a
+        # model variant at any patch-aligned image_size
+        k = self.k
+        toks = []
+        for im in imgs:
+            model_r = JumboViT(self.cfg.replace(image_size=im.shape[0]))
+            toks.append(
+                np.asarray(
+                    model_r.apply(
+                        self.vars_, im[None].astype(np.float32),
+                        method=JumboViT.patchify,
+                    )
+                )[0]
+            )
+        lens = [t.shape[0] + k for t in toks]
+        plan = packing.pack_ffd(lens, 64)
+        arrs = packing.build_arrays(plan, k)
+        buf = packing.place_tokens(plan, toks, k)
+        return plan, arrs, buf
+
+    def _serve(self, arrs, buf):
+        out = self.model.apply(
+            self.vars_, buf, arrs["segment_ids"], arrs["cls_pos"],
+            arrs["cls_index"], method=self.model.serve_packed,
+        )
+        return np.asarray(out["pooled"])
+
+    def test_segment_isolation_is_bit_exact(self):
+        # same plan geometry, different pixels in request 1 only
+        a = _images([16, 16, 16], seed=1)
+        b = [a[0], _images([16], seed=99)[0], a[2]]
+        plan_a, arrs_a, buf_a = self._pack(a)
+        plan_b, arrs_b, buf_b = self._pack(b)
+        assert plan_a == plan_b  # identical lengths -> identical plan
+        out_a = self._serve(arrs_a, buf_a)
+        out_b = self._serve(arrs_b, buf_b)
+        for s in plan_a.segments:
+            same = np.array_equal(
+                out_a[s.row, s.slot], out_b[s.row, s.slot]
+            )
+            if s.request == 1:
+                assert not same, "perturbed request must actually change"
+            else:
+                assert same, f"request {s.request} leaked across segments"
+
+    def test_pad_tokens_are_inert(self):
+        imgs = _images([16, 16], seed=2)
+        plan, arrs, buf = self._pack(imgs)
+        clean = self._serve(arrs, buf)
+        # garbage everywhere the plan owns nothing (segment id 0)
+        dirty = buf.copy()
+        pad = arrs["segment_ids"] == 0
+        dirty[pad] = 1e6
+        noisy = self._serve(arrs, dirty)
+        for s in plan.segments:
+            assert np.array_equal(
+                clean[s.row, s.slot], noisy[s.row, s.slot]
+            ), "pad values reached a real segment"
+
+    def test_bucketed_extra_rows_are_inert(self):
+        # the executable runs row-bucketed (rows=4 for a 2-row plan); the
+        # extra all-pad rows must be bit-inert WITHIN that fixed shape —
+        # garbage there cannot move any real segment. (Comparing across
+        # different row counts is a different XLA program and only agrees
+        # to ULP, so the bit-exact claim is same-shape.)
+        imgs = _images([16, 16], seed=3)
+        plan, _, buf1 = self._pack(imgs)
+        arrs4 = packing.build_arrays(
+            plan, self.k, rows=4, max_segments=plan.max_segments
+        )
+        buf4 = np.zeros((4,) + buf1.shape[1:], buf1.dtype)
+        buf4[: buf1.shape[0]] = buf1
+        clean = self._serve(arrs4, buf4)
+        dirty = buf4.copy()
+        dirty[plan.rows :] = 1e6  # entire bucketed rows are garbage
+        noisy = self._serve(arrs4, dirty)
+        for s in plan.segments:
+            assert np.array_equal(
+                clean[s.row, s.slot], noisy[s.row, s.slot]
+            ), "bucketed pad rows reached a real segment"
+
+
+# ------------------------------------------------- engine pipeline
+
+
+class TestPredictPacked:
+    def test_end_to_end_mixed_resolutions(self, engine):
+        imgs = _images([24, 32, 32, 40], seed=4)
+        out = engine.predict_packed(imgs, "features")
+        assert len(out) == 4
+        dim = out[0].shape[-1]
+        assert all(o.shape[-1] == dim for o in out)
+        bd = engine.last_breakdown()
+        assert 0.0 <= bd["pad_fraction"] < 1.0
+
+    def test_parity_features_two_resolutions(self, engine):
+        rep = engine.packed_parity(_images([24, 24, 32, 32, 40], seed=5))
+        assert rep["pass"], rep
+        assert rep["feature_cosine_min"] >= 0.999
+
+    def test_parity_mixed_tasks(self, engine_labels):
+        imgs = _images([24, 32, 32, 40], seed=6)
+        tasks = ["features", "logits", "features", "logits"]
+        rep = engine_labels.packed_parity(imgs, tasks)
+        assert rep["pass"], rep
+        assert rep["logits_top1_agree"] >= 0.98
+        out = engine_labels.predict_packed(imgs, tasks)
+        assert out[1].shape[-1] == 11  # logits rows carry label logits
+        assert out[0].shape[-1] != 11 or out[0].ndim != out[1].ndim
+
+    def test_unaligned_size_rejected(self, engine):
+        with pytest.raises(ValueError, match="patch"):
+            engine.seq_len(30)  # not a multiple of patch 4
+        with pytest.raises(ValueError):
+            engine.predict_packed(_images([30], seed=7))
+
+    def test_resolution_mismatch_is_typed_on_unpacked_path(self, engine):
+        with pytest.raises(ResolutionMismatchError) as ei:
+            engine.predict(np.stack(_images([24, 24], seed=8)))
+        assert ei.value.expected == 32
+        assert ei.value.got == (24, 24)
+        # and the packed path accepts exactly that request
+        out = engine.predict_packed(_images([24, 24], seed=8))
+        assert len(out) == 2
+
+    def test_warmup_packed_precompiles(self):
+        eng = InferenceEngine(tiny_cfg(), max_batch=4, max_tokens=512)
+        n = eng.warmup_packed([24, 32, 32], ("features",))
+        assert n > 0
+        before = sum(eng.compile_counts.values())
+        eng.predict_packed(_images([24, 32, 32], seed=9), "features")
+        assert sum(eng.compile_counts.values()) == before, (
+            "hot path compiled after warmup_packed"
+        )

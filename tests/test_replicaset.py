@@ -943,3 +943,69 @@ def test_close_during_restart_never_respawns_slot():
     assert rep.thread is None or not rep.thread.is_alive()
     with pytest.raises(ShutdownError):
         rs.submit(_img())
+
+
+# ------------------------------------------------- atomic dispatch groups
+
+
+def test_submit_group_boundaries_never_merge_in_one_flush():
+    """The worker must not coalesce across ``submit_group`` boundaries:
+    the occupancy (and, packed, the token geometry) the scheduler
+    assembled is what the replica runs. Two groups queued back-to-back on
+    one busy replica flush as two batches, never one merged batch — even
+    though max_batch would allow the merge."""
+    from concurrent.futures import wait
+
+    gate = threading.Event()
+    flushes = []
+
+    def run_gated(eng, batch, metas):
+        gate.wait(timeout=10)
+        flushes.append([im.shape[0] for im in batch] if isinstance(
+            batch, list) else [batch.shape[1]] * batch.shape[0])
+        return {"y": np.zeros(len(metas) if isinstance(batch, list)
+                              else batch.shape[0])}
+
+    rs, reg = _pool(run_gated, replicas=1, max_batch=16, max_delay_ms=1.0)
+    try:
+        now = time.monotonic()
+        # park the worker on a decoy so both groups are queued before any
+        # coalescing loop runs
+        decoy = rs.submit(_img())
+        time.sleep(0.05)
+        g1 = rs.submit_group([(np.full((4, 4, 3), 1.0, np.float32),
+                               now + 30.0, None, None)] * 2)
+        g2 = rs.submit_group([(np.full((4, 4, 3), 2.0, np.float32),
+                               now + 30.0, None, None)] * 3)
+        gate.set()
+        done, _ = wait([decoy] + g1 + g2, timeout=10)
+        assert len(done) == 6
+    finally:
+        rs.close()
+    # three flushes: the decoy, then each group intact — never [2+3] merged
+    assert [len(f) for f in flushes] == [1, 2, 3]
+
+
+def test_worker_carry_lookahead_is_not_lost_on_exit():
+    """A worker that peeked past a group boundary holds a carry record;
+    close() (or a crash) must requeue/resolve it, never orphan it."""
+    gate = threading.Event()
+
+    def run_gated(eng, batch, metas):
+        gate.wait(timeout=10)
+        n = len(metas)
+        return {"y": np.zeros(n)}
+
+    rs, reg = _pool(run_gated, replicas=1, max_batch=16, max_delay_ms=1.0)
+    now = time.monotonic()
+    decoy = rs.submit(_img())
+    time.sleep(0.05)
+    g1 = rs.submit_group([(_img(1.0), now + 30.0, None, None)] * 2)
+    g2 = rs.submit_group([(_img(2.0), now + 30.0, None, None)] * 2)
+    gate.set()
+    rs.close()  # drain: everything queued (carry included) must resolve
+    for f in [decoy] + g1 + g2:
+        assert f.done()
+        # ok or shutdown are both legal under close(); lost/hung is not
+        exc = f.exception()
+        assert exc is None or isinstance(exc, ShutdownError)

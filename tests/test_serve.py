@@ -763,3 +763,130 @@ def test_loadgen_schedule_deterministic_and_profiled():
     with pytest.raises(ValueError):
         loadgen.rate_at("tsunami", 0.0, 1.0, 1.0, 1.0)
     assert {t for _, t in a} == {"web", "scrape"}
+
+
+# ------------------------------------------------- token-packed scheduling
+
+
+def _sq(size, v=0.0):
+    """A square image whose side doubles as its token count via
+    ``seq_len_fn=lambda a: a.shape[0]``."""
+    return np.full((size, size, 3), v, np.float32)
+
+
+_tok = staticmethod(lambda arr: arr.shape[0])
+
+
+def test_packed_scheduler_fills_token_budget_not_image_count():
+    """Mixed 'resolutions' accumulate into ONE packed group that fires
+    when the token budget fills — image count alone never would."""
+    stub = DispatchStub()
+    sched = ContinuousScheduler(
+        stub, max_batch=64, max_delay_ms=500.0, registry=MetricsRegistry(),
+        packed=True, token_budget=100, seq_len_fn=lambda a: a.shape[0],
+    )
+    with sched:
+        futs = [sched.submit(_sq(s)) for s in (40, 30, 30)]  # = 100 tokens
+        done, _ = wait(futs, timeout=10)
+        assert len(done) == 3
+    # one dispatch, all three sizes, long before the 500ms cutoff
+    assert [i[0].shape[0] for i in stub.batches[0]] == [40, 30, 30]
+
+
+def test_packed_scheduler_skims_past_overflowing_entry():
+    """An entry that would overflow the remaining budget is skipped, not a
+    wall: smaller entries behind it top up the rung, and the skip counts
+    as a priority jump."""
+    gate = threading.Event()
+    stub = DispatchStub(gate=gate)
+    reg = MetricsRegistry()
+    sched = ContinuousScheduler(
+        stub, max_batch=64, max_delay_ms=40.0, registry=reg,
+        packed=True, token_budget=100, seq_len_fn=lambda a: a.shape[0],
+    )
+    try:
+        # a budget-filling decoy parks the dispatcher on the gate so all
+        # three contested entries are in the accumulator before any take
+        decoy = sched.submit(_sq(100))
+        time.sleep(0.05)
+        futs = [sched.submit(_sq(s)) for s in (60, 50, 30)]  # 140 > budget
+        time.sleep(0.02)
+        gate.set()
+        done, _ = wait([decoy] + futs, timeout=10)
+        assert len(done) == 4
+    finally:
+        sched.close()
+    sizes = [[i[0].shape[0] for i in b] for b in stub.batches]
+    assert sizes[0] == [100]
+    assert sizes[1] == [60, 30], "50 should be skimmed past, 30 taken"
+    assert sizes[2] == [50], "skipped entry ships next (head of order)"
+    assert "serve_sched_priority_jumps_total 1" in reg.render()
+
+
+def test_packed_scheduler_rejects_oversized_and_requires_seq_len_fn():
+    stub = DispatchStub()
+    with pytest.raises(ValueError, match="seq_len_fn"):
+        ContinuousScheduler(
+            stub, max_batch=8, registry=MetricsRegistry(),
+            packed=True, token_budget=100,
+        )
+    sched = ContinuousScheduler(
+        stub, max_batch=8, max_delay_ms=5.0, registry=MetricsRegistry(),
+        packed=True, token_budget=100, seq_len_fn=lambda a: a.shape[0],
+    )
+    with sched:
+        with pytest.raises(ValueError, match="token_budget"):
+            sched.submit(_sq(101))
+
+
+@pytest.mark.parametrize("packed", [True, False])
+def test_scheduler_stamps_token_counts_on_traces(tmp_path, packed):
+    """With a seq_len_fn the scheduler prices every entry and stamps
+    ``tr.tokens`` — packed or not (the image-bucket control leg bills its
+    padded token count pro-rata through the same field)."""
+    log = AccessLog(tmp_path / "access")
+    tracer = RequestTracer(registry=MetricsRegistry(), access_log=log)
+    stub = DispatchStub()
+    sched = ContinuousScheduler(
+        stub, max_batch=8, max_delay_ms=5.0, registry=MetricsRegistry(),
+        tracer=tracer, packed=packed,
+        token_budget=100 if packed else None,
+        seq_len_fn=lambda a: a.shape[0],
+    )
+    try:
+        futs = [sched.submit(_sq(40)), sched.submit(_sq(40))]
+        wait(futs, timeout=10)
+    finally:
+        sched.close()
+        tracer.close()
+    traces = [tr for b in stub.batches for (_, _, _, tr) in b]
+    assert sorted(tr.tokens for tr in traces) == [40, 40]
+
+
+def test_loadgen_resolution_grammar_and_size_draws():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent.parent / "tools")
+    )
+    import loadgen
+
+    # 'lo-hi:w' and 'size:w' entries; bare weight defaults to 1
+    assert loadgen.parse_res_spec("160-224:0.5,448:0.3,896") == [
+        (160, 224, 0.5), (448, 448, 0.3), (896, 896, 1.0),
+    ]
+    rng = np.random.RandomState(7)
+    draws = loadgen.draw_sizes(rng, [(24, 32, 1.0), (52, 64, 2.0)], 400, 4)
+    assert all(b in (32, 64) for _, b in draws)
+    for native, bucket in draws:
+        lo = 24 if bucket == 32 else 52
+        assert lo <= native <= bucket and native % 4 == 0
+    # weighted: the 52-64 range should dominate ~2:1
+    hi = sum(1 for _, b in draws if b == 64)
+    assert 200 < hi < 340
+    # seeded determinism: same seed, same draws
+    again = loadgen.draw_sizes(
+        np.random.RandomState(7), [(24, 32, 1.0), (52, 64, 2.0)], 400, 4
+    )
+    assert draws == again

@@ -99,41 +99,150 @@ def parse_mix(spec: str, tenant_names: list[str]) -> list[tuple[str, float]]:
     return mix
 
 
+def parse_weighted(spec: str, cast=str) -> list[tuple, ...]:
+    """``a:0.5,b:0.3`` → [(cast(a), 0.5), (cast(b), 0.3)] (weight 1 when
+    omitted) — the ``--task-mix`` grammar."""
+    out = []
+    for entry in spec.split(","):
+        val, _, w = entry.partition(":")
+        out.append((cast(val.strip()), float(w or 1.0)))
+    return out
+
+
+def draw_weighted(rng, options: list[tuple], n: int) -> list:
+    """Seeded draw of n values from [(value, weight), ...]."""
+    vals = [v for v, _ in options]
+    w = np.asarray([max(x, 0.0) for _, x in options], np.float64)
+    w = w / w.sum()
+    return [vals[int(i)] for i in rng.choice(len(vals), size=n, p=w)]
+
+
+def parse_res_spec(spec: str) -> list[tuple[int, int, float]]:
+    """``--resolutions`` grammar: ``lo-hi:weight`` or ``size:weight``
+    entries → ``[(lo, hi, weight), ...]``. ``hi`` is the image-bucket
+    control leg's resolution rung (requests are padded up to it); native
+    sizes draw uniformly from the patch multiples in ``[lo, hi]`` — the
+    token-packed leg serves those natively."""
+    out = []
+    for entry in spec.split(","):
+        rng_part, _, w = entry.partition(":")
+        lo_s, dash, hi_s = rng_part.partition("-")
+        lo = int(lo_s)
+        hi = int(hi_s) if dash else lo
+        out.append((lo, hi, float(w or 1.0)))
+    return out
+
+
+def draw_sizes(
+    rng, opts: list[tuple[int, int, float]], n: int, patch: int
+) -> list[tuple[int, int]]:
+    """Seeded per-arrival draw of ``(native, bucket)`` sizes: the bucket
+    (range hi) by weight, then the native size uniform on the patch
+    multiples in ``[lo, hi]``."""
+    w = np.asarray([max(x, 0.0) for *_, x in opts], np.float64)
+    w = w / w.sum()
+    picks = rng.choice(len(opts), size=n, p=w)
+    out = []
+    for i in picks:
+        lo, hi, _ = opts[int(i)]
+        kmin = -(-lo // patch)  # ceil to the next patch multiple
+        kmax = hi // patch
+        out.append((patch * int(rng.randint(kmin, kmax + 1)), hi))
+    return out
+
+
 # ------------------------------------------------------------ model engine
 
 
 def bucket_of(k: int, max_batch: int) -> int:
-    b = 1
-    while b < k:
-        b *= 2
-    return min(b, max_batch)
+    """Report-side view of the engine's pad bucket — the shared ladder
+    definition, so the pad accounting can't drift from what the device
+    actually ran."""
+    from jumbo_mae_tpu_tpu.infer.bucketing import bucket_for
+
+    return bucket_for(k, max_batch)
 
 
 class _ModelEngine:
-    """Service-time model standing in for an InferenceEngine: a flush of k
-    items costs ``overhead + bucket(k)·per_item`` (padded rows compute
-    too — that is exactly the waste the continuous scheduler removes)."""
+    """Service-time model standing in for an InferenceEngine: a flush
+    costs ``overhead + device_units·per_unit`` where the padded device
+    units are what the real executable would run — that padding is exactly
+    the waste the continuous scheduler (and the token-packed path) removes.
 
-    def __init__(self, overhead_s: float, per_item_s: float, max_batch: int):
+    Three pricing modes, selected by the constructor:
+
+    - default — one unit per image row, ``bucket(k)`` device rows (the
+      original model; padded rows compute too);
+    - ``seq_len_fn`` — units are patch+CLS tokens; a homogeneous flush of
+      k images at L tokens runs ``bucket(k)·L`` device tokens (image
+      buckets pad whole rows of L tokens — the A/B control leg);
+    - ``seq_len_fn`` + ``token_budget`` — token-packed dispatch: device
+      tokens come from the REAL ``infer/packing.py`` planner
+      (``ceil_pow2(rows)·budget``), so the modeled A/B win is the same
+      geometry the engine's packed executables run.
+    """
+
+    def __init__(
+        self,
+        overhead_s: float,
+        per_item_s: float,
+        max_batch: int,
+        *,
+        seq_len_fn=None,
+        token_budget: int | None = None,
+    ):
         self.overhead_s = overhead_s
         self.per_item_s = per_item_s
         self.max_batch = max_batch
+        self.seq_len_fn = seq_len_fn
+        self.token_budget = token_budget
         self.last_k = 0
+        self.last_req_units = 0
+        self.last_dev_units = 0
 
-    def run(self, batch: np.ndarray) -> np.ndarray:
+    def _price(self, batch) -> tuple[int, int]:
+        """(requested_units, device_units) for one flush."""
         k = len(batch)
-        self.last_k = k
-        b = bucket_of(k, self.max_batch)
-        time.sleep(self.overhead_s + b * self.per_item_s)
+        if self.seq_len_fn is None:
+            return k, bucket_of(k, self.max_batch)
+        lens = [int(self.seq_len_fn(im)) for im in batch]
+        req = sum(lens)
+        if self.token_budget:
+            from jumbo_mae_tpu_tpu.infer.bucketing import ceil_pow2
+            from jumbo_mae_tpu_tpu.infer.packing import (
+                budget_rungs,
+                choose_budget,
+            )
+
+            budget, plan = choose_budget(lens, budget_rungs(self.token_budget))
+            return req, ceil_pow2(plan.rows) * budget
+        # image buckets: one bucketed executable per resolution chunk —
+        # every padded row costs that chunk's full sequence (flushes are
+        # homogeneous behind the continuous scheduler; pricing per chunk
+        # keeps this honest for any mixed flush too)
+        by_len: dict[int, int] = {}
+        for ln in lens:
+            by_len[ln] = by_len.get(ln, 0) + 1
+        dev = sum(
+            bucket_of(n, self.max_batch) * ln for ln, n in by_len.items()
+        )
+        return req, dev
+
+    def run(self, batch):
+        self.last_k = len(batch)
+        req, dev = self._price(batch)
+        self.last_req_units, self.last_dev_units = req, dev
+        time.sleep(self.overhead_s + dev * self.per_item_s)
+        if isinstance(batch, list):  # mixed shapes: a token-packed group
+            return [im * 2.0 for im in batch]
         return batch * 2.0
 
     def breakdown(self) -> dict:
-        k = self.last_k
-        b = bucket_of(k, self.max_batch) if k else 0
+        dev = self.last_dev_units
         return {
-            "compute_s": self.overhead_s + b * self.per_item_s,
-            "bucket": b,
-            "pad_fraction": (b - k) / b if b else 0.0,
+            "compute_s": self.overhead_s + dev * self.per_item_s,
+            "bucket": dev,
+            "pad_fraction": (dev - self.last_req_units) / dev if dev else 0.0,
         }
 
 
@@ -202,6 +311,50 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--config", default="", help="YAML recipe: use a real engine")
     p.add_argument("--task", default="features")
+    p.add_argument(
+        "--resolutions",
+        default="",
+        metavar="SPEC",
+        help="mixed-resolution arrivals 'lo-hi:weight,...' (e.g. "
+        "'160-224:0.5,320-448:0.3,640-896:0.2'), drawn per arrival from "
+        "the run seed. Each arrival picks a range by weight, then a native "
+        "size uniform on the patch multiples in [lo, hi]; the image-bucket "
+        "control leg pads it up to hi (the resolution rung) while "
+        "--pack-budget serves it natively. 'size:weight' pins lo = hi. "
+        "Needs --scheduler continuous",
+    )
+    p.add_argument(
+        "--task-mix",
+        default="",
+        metavar="SPEC",
+        help="per-arrival task draw, e.g. 'features:0.7,logits:0.3'; "
+        "needs --scheduler continuous",
+    )
+    p.add_argument(
+        "--pack-budget",
+        type=int,
+        default=0,
+        help=">0: token-packed dispatch — the continuous scheduler fills "
+        "each dispatch to this many patch+CLS tokens instead of counting "
+        "images (the infer/packing.py serving path). 0 = image buckets "
+        "(the A/B control leg)",
+    )
+    p.add_argument(
+        "--patch-size",
+        type=int,
+        default=16,
+        help="token pricing for the modeled engine: "
+        "tokens(s) = (s/patch)^2 + 1 (a real --config engine prices with "
+        "its own seq_len)",
+    )
+    p.add_argument(
+        "--service-per-token-us",
+        type=float,
+        default=20.0,
+        help="modeled per-device-token cost, used instead of "
+        "--service-per-item-ms when token mode (--resolutions or "
+        "--pack-budget) is on",
+    )
     p.add_argument("--access-log", default="", metavar="DIR")
     p.add_argument(
         "--metrics-out",
@@ -245,9 +398,28 @@ def main(argv: list[str] | None = None) -> dict:
         args.profile, args.duration_s, args.base_rps, args.peak_rps,
         mix, args.seed,
     )
+
+    res_opts = parse_res_spec(args.resolutions) if args.resolutions else []
+    task_opts = parse_weighted(args.task_mix, str) if args.task_mix else []
+    pack_budget = int(args.pack_budget or 0)
+    token_mode = bool(res_opts or pack_budget)
+    if (token_mode or task_opts) and args.scheduler != "continuous":
+        raise SystemExit(
+            "[loadgen] --resolutions/--task-mix/--pack-budget need "
+            "--scheduler continuous (FIFO micro-batching stacks one shape "
+            "and one task per flush)"
+        )
+    for lo, hi, _ in res_opts:
+        if lo <= 0 or lo > hi or hi % args.patch_size:
+            raise SystemExit(
+                f"[loadgen] bad resolution range {lo}-{hi}: need "
+                f"0 < lo <= hi with hi a multiple of --patch-size "
+                f"{args.patch_size}"
+            )
+    sched_label = "packed" if pack_budget else args.scheduler
     print(
         f"[loadgen] {args.profile}: {len(schedule)} arrivals over "
-        f"{args.duration_s:g}s (seed {args.seed}, scheduler {args.scheduler})"
+        f"{args.duration_s:g}s (seed {args.seed}, scheduler {sched_label})"
     )
 
     if not args.access_log:
@@ -270,6 +442,10 @@ def main(argv: list[str] | None = None) -> dict:
     )
 
     flush_sizes: list[int] = []
+    flush_tokens: list[tuple[int, int]] = []  # (requested, device) tokens
+    seq_len_fn = None
+    image = None
+    image_by_size: dict[int, np.ndarray] = {}
     if args.config:
         from jumbo_mae_tpu_tpu.config import load_config
         from jumbo_mae_tpu_tpu.infer import InferenceEngine
@@ -277,35 +453,155 @@ def main(argv: list[str] | None = None) -> dict:
         cfg = load_config(args.config, [])
 
         def provider(idx):
-            return InferenceEngine(cfg, max_batch=args.max_batch)
+            # max_tokens is the packer's rung ceiling, kept above the
+            # scheduler's fill target (--pack-budget) so flushes that merge
+            # consecutive dispatch groups still pack efficiently
+            return InferenceEngine(
+                cfg,
+                max_batch=args.max_batch,
+                **(
+                    {"max_tokens": max(pack_budget, 4096)}
+                    if pack_budget
+                    else {}
+                ),
+            )
+
+        probe_engine = provider(0)
+        size = probe_engine.image_size
+        if token_mode:
+            if not res_opts:
+                res_opts = [(size, size, 1.0)]
+            if not pack_budget and any(
+                lo != size or hi != size for lo, hi, _ in res_opts
+            ):
+                raise SystemExit(
+                    "[loadgen] a real engine's unpacked predict serves only "
+                    f"its native {size}px — run the mixed-resolution control "
+                    "leg on the modeled engine (drop --config) or add "
+                    "--pack-budget"
+                )
+
+            def seq_len_fn(arr):
+                return probe_engine.seq_len(arr.shape[0])
+
+            def tokens_of(s):
+                return probe_engine.seq_len(s)
 
         def run(engine, batch, metas):
             flush_sizes.append(len(batch))
-            return engine.predict(batch, task=args.task)
+            if pack_budget:
+                tasks = (
+                    [m["task"] for m in metas] if task_opts else args.task
+                )
+                out = engine.predict_packed(list(batch), tasks)
+            else:
+                task = (
+                    metas[0]["task"]
+                    if task_opts and metas and metas[0]
+                    else args.task
+                )
+                out = engine.predict(batch, task=task)
+            if token_mode:
+                # requested = the arrival's NATIVE tokens (meta-stamped) —
+                # resolution padding up to the bucket is device waste too
+                req = sum(
+                    int((m or {}).get("tok") or seq_len_fn(im))
+                    for m, im in zip(metas, batch)
+                )
+                bd = engine.last_breakdown() or {}
+                pad = float(bd.get("pad_fraction") or 0.0)
+                if pack_budget:
+                    # packed breakdowns are token-denominated: invert the
+                    # pad fraction back to device tokens
+                    dev = int(round(req / (1.0 - pad))) if pad < 1.0 else req
+                else:
+                    dev = int(bd.get("bucket") or len(batch)) * int(
+                        seq_len_fn(batch[0])
+                    )
+                flush_tokens.append((req, dev))
+            return out
 
         def breakdown(engine):
             return engine.last_breakdown()
 
         cost_fn = default_cost_fn  # real executables publish cost_reports
 
-        probe_engine = provider(0)
-        size = probe_engine.image_size
-        image = (
-            np.random.RandomState(args.seed)
-            .randint(0, 256, (size, size, 3))
-            .astype(np.uint8)
-        )
+        rng_img = np.random.RandomState(args.seed)
+
+        def image_for(s):
+            if s not in image_by_size:
+                image_by_size[s] = (
+                    rng_img.randint(0, 256, (s, s, 3)).astype(np.uint8)
+                )
+            return image_by_size[s]
+
+        image = image_for(size) if not token_mode else None
         capacity_fn = None
     else:
         overhead = args.service_overhead_ms / 1000.0
-        per_item = args.service_per_item_ms / 1000.0
+        per_unit = (
+            args.service_per_token_us / 1e6
+            if token_mode
+            else args.service_per_item_ms / 1000.0
+        )
+        rung_ceiling = None
+        if token_mode:
+            if not res_opts:
+                res_opts = [(224, 224, 1.0)]
+            patch = args.patch_size
+
+            def seq_len_fn(arr):
+                return (arr.shape[0] // patch) ** 2 + 1
+
+            def tokens_of(s):
+                return (s // patch) ** 2 + 1
+
+            def image_for(s):
+                if s not in image_by_size:
+                    image_by_size[s] = np.ones((s, s, 3), dtype=np.float32)
+                return image_by_size[s]
+
+            if pack_budget:
+                # the packer's rung ceiling is NOT the scheduler's fill
+                # target: a busy replica merges consecutive dispatch groups
+                # into one flush, and capping the rungs at the fill target
+                # would force pow2-row geometry (e.g. 5 rows -> 8x512) on
+                # those merged flushes — headroom lets choose_budget keep
+                # picking the cheapest rows x budget for whatever arrives
+                from jumbo_mae_tpu_tpu.infer.bucketing import ceil_pow2
+
+                max_seq = max(tokens_of(hi) for _, hi, _ in res_opts)
+                rung_ceiling = max(
+                    pack_budget, ceil_pow2(args.max_batch * max_seq)
+                )
+        else:
+            image = np.ones((8, 8), dtype=np.float32)
 
         def provider(idx):
-            return _ModelEngine(overhead, per_item, args.max_batch)
+            return _ModelEngine(
+                overhead,
+                per_unit,
+                args.max_batch,
+                seq_len_fn=seq_len_fn,
+                token_budget=rung_ceiling,
+            )
 
         def run(engine, batch, metas):
             flush_sizes.append(len(batch))
-            return engine.run(batch)
+            out = engine.run(batch)
+            if token_mode:
+                # requested = NATIVE tokens (meta-stamped): in the control
+                # leg images arrive pre-padded to their resolution bucket,
+                # and that padding is device waste the bill must carry
+                req = sum(
+                    int((m or {}).get("tok") or seq_len_fn(im))
+                    for m, im in zip(metas, batch)
+                )
+                engine.last_req_units = min(req, engine.last_dev_units)
+                flush_tokens.append(
+                    (engine.last_req_units, engine.last_dev_units)
+                )
+            return out
 
         def breakdown(engine):
             return engine.breakdown()
@@ -313,15 +609,42 @@ def main(argv: list[str] | None = None) -> dict:
         flops_per_row = args.model_gflops_per_item * 1e9
 
         def cost_fn(engine, task, bucket):
-            # the modeled executable: every bucket row costs the same
+            # the modeled executable: every device unit (bucket row — or
+            # device token in token mode) costs the same
             return {"flops": bucket * flops_per_row}
 
-        image = np.ones((8, 8), dtype=np.float32)
-
         def capacity_fn():
-            # the model's own roofline: a full bucket amortizes overhead
-            full = overhead + args.max_batch * per_item
+            # the model's own roofline: a full dispatch amortizes overhead
+            if token_mode:
+                w = sum(x for *_, x in res_opts) or 1.0
+                # control pads each arrival up to its range's bucket (hi);
+                # packed serves the native mid-range size — use the
+                # matching token count per leg for the roofline
+                p = args.patch_size
+
+                def leg_tok(lo, hi):
+                    s = hi if not pack_budget else (lo + hi) // 2
+                    return (s // p) ** 2 + 1
+
+                mean_tok = (
+                    sum(leg_tok(lo, hi) * x for lo, hi, x in res_opts) / w
+                )
+                dev = (
+                    pack_budget if pack_budget else args.max_batch * mean_tok
+                )
+                items = dev / mean_tok if pack_budget else args.max_batch
+                return items / (overhead + dev * per_unit)
+            full = overhead + args.max_batch * per_unit
             return args.max_batch / full
+
+    if pack_budget and res_opts:
+        for _, hi, _ in res_opts:
+            need = int(tokens_of(hi))
+            if need > pack_budget:
+                raise SystemExit(
+                    f"[loadgen] {hi}px needs {need} tokens > --pack-budget "
+                    f"{pack_budget}"
+                )
 
     # continuous mode: the scheduler's accumulator is the admission-visible
     # queue; the pool gets headroom above it so a dispatched group doesn't
@@ -354,6 +677,13 @@ def main(argv: list[str] | None = None) -> dict:
             admission=admission,
             tracer=tracer,
             task=args.task,
+            packed=bool(pack_budget),
+            token_budget=pack_budget or None,
+            # pass seq_len_fn in control token mode too: the scheduler
+            # stamps tr.tokens from it, and for the control leg those are
+            # BUCKET tokens (the image arrives pre-padded) — exactly what
+            # that customer is billed for
+            seq_len_fn=seq_len_fn if token_mode else None,
         )
         # combined pressure: the scheduler's accumulator AND the pool's
         # backlog — either one filling up should start shedding low classes
@@ -378,20 +708,54 @@ def main(argv: list[str] | None = None) -> dict:
         )
 
     # ------------------------------------------------------------- replay
+    # per-arrival resolution/task draws are seeded separately from the
+    # schedule so packed and control legs replay the identical mixed load
+    rng_mix = np.random.RandomState(args.seed + 1)
+    sizes_seq = (
+        draw_sizes(rng_mix, res_opts, len(schedule), args.patch_size)
+        if token_mode
+        else None
+    )
+    tasks_seq = (
+        draw_weighted(rng_mix, task_opts, len(schedule)) if task_opts else None
+    )
+    rid_info: dict[int, dict] = {}  # fut.rid -> arrival's size/task draw
     futs = []
     shed = 0
     t0 = time.monotonic()
-    for t_offset, tenant in schedule:
+    for i, (t_offset, tenant) in enumerate(schedule):
         delay = t0 + t_offset - time.monotonic()
         if delay > 0:
             time.sleep(delay)
+        if sizes_seq:
+            native, bucket = sizes_seq[i]
+            # the control leg pays the paper's waste here: the request is
+            # padded up to its resolution bucket before it ever queues;
+            # the packed leg submits the native size as-is
+            img = image_for(native if pack_budget else bucket)
+            meta = {"tok": int(tokens_of(native))}
+        else:
+            img, meta = image, {}
+        tk = tasks_seq[i] if tasks_seq else None
+        if tk:
+            meta["task"] = tk
         try:
             if sched is not None:
-                futs.append(
-                    sched.submit(
-                        image, deadline_ms=args.deadline_ms, tenant=tenant
-                    )
+                fut = sched.submit(
+                    img,
+                    deadline_ms=args.deadline_ms,
+                    tenant=tenant,
+                    task=tk,
+                    meta=(meta or None),
                 )
+                rid = getattr(fut, "rid", None)
+                if rid is not None and (sizes_seq or tasks_seq):
+                    rid_info[rid] = {
+                        "size": sizes_seq[i][1] if sizes_seq else None,
+                        "native": sizes_seq[i][0] if sizes_seq else None,
+                        "task": tk,
+                    }
+                futs.append(fut)
             else:
                 sp = admission.admit(tenant)
                 futs.append(
@@ -432,6 +796,15 @@ def main(argv: list[str] | None = None) -> dict:
     size_hist: dict[int, int] = {}
     for k in flush_sizes:
         size_hist[int(k)] = size_hist.get(int(k), 0) + 1
+    # token-denominated waste: fraction of device tokens that were padding
+    # (the packed-vs-bucketed A/B compares this, not row pad)
+    tokens_requested = sum(r for r, _ in flush_tokens)
+    tokens_device = sum(d for _, d in flush_tokens)
+    token_pad_mean = (
+        round((tokens_device - tokens_requested) / tokens_device, 4)
+        if tokens_device
+        else None
+    )
 
     per_tenant: dict[str, dict] = {}
     try:
@@ -474,6 +847,39 @@ def main(argv: list[str] | None = None) -> dict:
             t["budget_device_s"] = bill["budget_device_s"]
             t["over_budget"] = bill["over_budget"]
 
+    # per-resolution columns: join the access log back to each arrival's
+    # seeded size draw via the rid the scheduler stamped on the future
+    per_resolution: dict[int, dict] = {}
+    if rid_info:
+        for r in req_rows:
+            info = rid_info.get(r.get("rid"))
+            if info is None or info["size"] is None:
+                continue
+            col = per_resolution.setdefault(
+                info["size"],
+                {"requests": 0, "ok": 0, "shed": 0, "tokens_billed": 0,
+                 "lat_ms": []},
+            )
+            col["requests"] += 1
+            if r["outcome"] == "ok":
+                col["ok"] += 1
+                col["lat_ms"].append(r["lat_ms"])
+                col["tokens_billed"] += int(r.get("tokens") or 0)
+            elif r["outcome"] == "shed":
+                col["shed"] += 1
+        for col in per_resolution.values():
+            lats = sorted(col.pop("lat_ms"))
+            col["p50_ms"] = round(lats[len(lats) // 2], 2) if lats else None
+            col["p99_ms"] = (
+                round(lats[min(len(lats) - 1, int(0.99 * len(lats)))], 2)
+                if lats
+                else None
+            )
+    task_hist: dict[str, int] = {}
+    for info in rid_info.values():
+        if info["task"]:
+            task_hist[info["task"]] = task_hist.get(info["task"], 0) + 1
+
     all_lat = sorted(
         r["lat_ms"] for r in req_rows if r["outcome"] == "ok"
     )
@@ -498,7 +904,7 @@ def main(argv: list[str] | None = None) -> dict:
 
     result = {
         "profile": args.profile,
-        "scheduler": args.scheduler,
+        "scheduler": sched_label,
         "seed": args.seed,
         "duration_s": round(wall, 3),
         "offered": len(schedule),
@@ -517,8 +923,16 @@ def main(argv: list[str] | None = None) -> dict:
         "p99_ms": q(0.99),
         "occupancy_mean": round(occupancy_mean, 4),
         "pad_mean": round(pad_mean, 4),
+        "token_pad_mean": token_pad_mean,
+        "tokens_requested": tokens_requested or None,
+        "tokens_device": tokens_device or None,
+        "pack_budget": pack_budget or None,
         "batches": len(flush_sizes),
         "size_hist": {k: size_hist[k] for k in sorted(size_hist)},
+        "per_resolution": {
+            str(k): per_resolution[k] for k in sorted(per_resolution)
+        },
+        "task_hist": {k: task_hist[k] for k in sorted(task_hist)},
         "tenants": per_tenant,
         "cost": {
             "total_batches": cost["total_batches"],
@@ -533,10 +947,13 @@ def main(argv: list[str] | None = None) -> dict:
         "interactive_slo_ok": interactive_ok,
         "slo": slo_report,
     }
+    tok_note = (
+        f" token_pad={result['token_pad_mean']}" if token_mode else ""
+    )
     print(
         f"[loadgen] ok={ok} shed_at_submit={shed} "
         f"failed={failed} occ={result['occupancy_mean']} "
-        f"pad={result['pad_mean']} p99={result['p99_ms']}ms "
+        f"pad={result['pad_mean']}{tok_note} p99={result['p99_ms']}ms "
         f"device_s={result['cost']['total_device_s']} "
         f"autoscale_events={len(result['autoscale_events'])}"
     )
@@ -558,23 +975,30 @@ def main(argv: list[str] | None = None) -> dict:
         }
         for name, bill in cost["tenants"].items():
             legs[f"device_s_{name}"] = round(bill["device_s"], 4)
+        extra = {
+            "pad_mean": result["pad_mean"],
+            "waste_device_s": round(
+                sum(
+                    b["waste_device_s"] for b in cost["tenants"].values()
+                ),
+                4,
+            ),
+            "profile": args.profile,
+            "scheduler": sched_label,
+            "seed": args.seed,
+        }
+        if token_mode:
+            # the packed-vs-bucketed A/B legs: same seed, same mixed load,
+            # compared on token pad + billed waste by tools/perf_doctor.py
+            extra["token_pad_mean"] = result["token_pad_mean"]
+            extra["pack_budget"] = pack_budget or None
+            extra["resolutions"] = args.resolutions or None
         row = make_row(
             bench="serve",
-            metric=f"loadgen_{args.profile}_{args.scheduler}",
+            metric=f"loadgen_{args.profile}_{sched_label}",
             legs=legs,
             quantiles={"p50_ms": result["p50_ms"], "p99_ms": result["p99_ms"]},
-            extra={
-                "pad_mean": result["pad_mean"],
-                "waste_device_s": round(
-                    sum(
-                        b["waste_device_s"] for b in cost["tenants"].values()
-                    ),
-                    4,
-                ),
-                "profile": args.profile,
-                "scheduler": args.scheduler,
-                "seed": args.seed,
-            },
+            extra=extra,
         )
         if append_row(history, row):
             print(f"[loadgen] ledger row -> {history}")
