@@ -38,7 +38,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--ckpt",
         default="",
-        help="Orbax run/checkpoint dir or .msgpack params; random init if omitted",
+        help="Orbax run/checkpoint dir, .msgpack params, or a published "
+        "train→serve artifact dir (publish-NNNNNN); random init if omitted",
     )
     p.add_argument(
         "--task", choices=("features", "logits", "reconstruct"), default="logits"
@@ -591,8 +592,28 @@ def main(argv: list[str] | None = None) -> Path | None:
             else:
                 health.degraded_when(rs.degraded)
         if args.swap_watch:
+
+            def _swap_restore(path):
+                # publish artifacts (serve/publisher.py) resolve their
+                # delta chain with fingerprint verification; anything else
+                # takes the plain checkpoint restore path
+                from jumbo_mae_tpu_tpu.serve.publisher import (
+                    is_publish_artifact,
+                    resolve_chain,
+                )
+
+                if is_publish_artifact(path):
+                    params, stats, _ = resolve_chain(path)
+                    return params, stats
+                from jumbo_mae_tpu_tpu.train.checkpoint import (
+                    restore_inference_state,
+                )
+
+                return restore_inference_state(path, to_device=False)
+
             swap_ctl = WeightSwapController(
                 rs,
+                restore_fn=_swap_restore,
                 parity_min_cosine=args.swap_parity_min,
                 canary_requests=args.swap_canary_requests,
                 canary_timeout_s=args.swap_canary_timeout_s,
@@ -763,10 +784,36 @@ def main(argv: list[str] | None = None) -> Path | None:
         swap_stop = threading.Event()
         swap_thread = None
         if swap_ctl is not None:
+            import os
+
+            from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
             watch_root = Path(args.swap_watch)
             watch_root.mkdir(parents=True, exist_ok=True)
+            c_quarantined = get_registry().counter(
+                "serve_publish_quarantined_total",
+                "publish artifacts the swap watcher quarantined before restore",
+            )
+
+            def _quarantine_artifact(p):
+                # a torn/poisoned publish artifact is evidence, not trash:
+                # move it aside (atomic, same filesystem) so the doctor can
+                # autopsy it and the watcher never retries it
+                qdir = watch_root / ".quarantine"
+                try:
+                    qdir.mkdir(exist_ok=True)
+                    os.replace(p, qdir / p.name)
+                except OSError:
+                    pass  # leave it in place; `seen` already skips it
+                c_quarantined.inc()
 
             def _watch_swaps():
+                from jumbo_mae_tpu_tpu.serve.publisher import (
+                    PublishIntegrityError,
+                    is_publish_artifact,
+                    verify_artifact,
+                )
+
                 # entries present at startup are the baseline, not pushes;
                 # push checkpoints by atomic rename so a partial write
                 # never gets picked up
@@ -778,6 +825,19 @@ def main(argv: list[str] | None = None) -> Path | None:
                             continue
                         seen.add(p.name)
                         print(f"[predict] swap-watch: new checkpoint {p}")
+                        if is_publish_artifact(p):
+                            # manifest fingerprint check BEFORE any bytes
+                            # reach a restore: torn or corrupted artifacts
+                            # are quarantined, never crash the watcher
+                            try:
+                                verify_artifact(p)
+                            except PublishIntegrityError as e:
+                                print(
+                                    f"[predict] swap {p.name}: "
+                                    f"verdict=quarantined stage=verify ({e})"
+                                )
+                                _quarantine_artifact(p)
+                                continue
                         rep = swap_ctl.swap(str(p))
                         msg = (
                             f"[predict] swap {p.name}: "

@@ -60,6 +60,7 @@ from jumbo_mae_tpu_tpu.models import (
 from jumbo_mae_tpu_tpu.parallel import batch_sharding, create_mesh
 from jumbo_mae_tpu_tpu.train import (
     Checkpointer,
+    RunEngine,
     create_sharded_state,
     load_pretrained_params,
     make_eval_step,
@@ -898,398 +899,419 @@ def train(cfg: TrainConfig) -> dict:
     bad_total = 0  # cumulative sentinel-bad steps (beacon field)
     step_ema_s: float | None = None
 
-    exit_reason = "completed"
-    pending: list = []  # [(step, device-metrics)] fetched at log time
     diag_pending: list = []  # [(step, device (G,3) stats)] fetched at log time
     prev_window_bad = False  # edge-trigger for the non-finite black box
     seen_quarantine: set = set()
-    step = start_step
-    try:
-        with trace(run.profile_dir or None):
-            while step < run.training_steps:
-                step += 1
-                # beacon BEFORE the data wait: under synchronous SPMD the
-                # fetched step counts stay lockstep, but a host stuck waiting
-                # on data sits at this step's entry while its peers dispatch
-                # ahead — that dispatch gap is exactly what fleet_step_lag sees
-                _beacon_write(step)
-                window_steps += 1
-                with sp_wait:
-                    batch = next(train_iter)
-                window_wait += sp_wait.last_s
-                health.beat("data_batch")
-                # fault sites train.loss / train.grad: traced multipliers into
-                # the step (NaN at chosen invocations, no recompile); the
-                # branch costs nothing when no plan is active
-                inject = None
-                if faults_active():
-                    # host.leak chaos site: corrupt(n) retains n MB/step in
-                    # the module ballast (the leak sentinel's test fixture);
-                    # a raise action models "the leak got fixed" and clears
-                    host_leak_tick(key=str(step))
-                    lm = fault_point("train.loss", key=str(step), data=1.0)
-                    gm = fault_point("train.grad", key=str(step), data=1.0)
-                    if (lm, gm) != (1.0, 1.0):
-                        inject = np.asarray([lm, gm], np.float32)
-                if retrace_sentinel is not None:
-                    retrace_sentinel.note("train_step", batch)
-                with sp_step:
-                    if inject is None:
-                        state, metrics = train_step(state, batch)
-                    elif retrace_sentinel is not None:
-                        # the inject arm is a distinct (legitimate)
-                        # executable — its first compile is not a retrace
-                        with retrace_sentinel.expected("fault-inject"):
-                            state, metrics = train_step(state, batch, inject)
-                    else:
-                        state, metrics = train_step(state, batch, inject)
-                c_steps.inc()
-                g_step.set(step)
-                health.beat("train_step")
-                if retrace_sentinel is not None and step == start_step + 1:
-                    retrace_sentinel.arm()  # warmup over: steady state begins
-                if diag_on:
-                    # keep the (G,3) stats array OUT of the scalar pending list
-                    # (the meter/sentinel consume scalars); fetch it only at the
-                    # diag cadence — off-cadence arrays are dropped on device
-                    metrics = dict(metrics)
-                    diag_dev = metrics.pop("diag")
-                    if step % run.diag_every == 0 or step == run.training_steps:
-                        diag_pending.append((step, diag_dev))
-                pending.append((step, metrics))
-                timer.tick()
-                # only cursor_log[step] (and prefetched future steps) are ever
-                # read — prune dead entries every iteration, not just at save
-                # time, or sparse checkpointing grows host memory without bound
-                for k in [k for k in cursor_log if k < step]:
-                    del cursor_log[k]
 
-                if step % run.log_interval == 0 or step == run.training_steps:
-                    # sync ONLY at log boundaries — per-step device_get/block
-                    # would serialize host dispatch against device compute
-                    want_rollback = False
-                    window_bad: list[int] = []
-                    for (s, m) in zip(
-                        (s for s, _ in pending),
-                        jax.device_get([m for _, m in pending]),
-                    ):
-                        skipped = float(m.get("skipped", 0.0)) >= 0.5
-                        loss_v = float(m.get("loss", math.nan))
-                        if skipped or not math.isfinite(loss_v):
-                            window_bad.append(s)
-                        gn = m.get("grad_norm")
-                        if gn is not None:
-                            g_grad_norm.set(float(gn))
-                        if flightrec is not None:
-                            entry = {"loss": loss_v}
-                            if gn is not None:
-                                entry["grad_norm"] = float(gn)
-                            if "finite_frac" in m:
-                                entry["finite_frac"] = float(m["finite_frac"])
-                            if skipped:
-                                entry["skipped"] = True
-                            flightrec.record_step(s, entry)
-                        if sentinel is not None and sentinel.observe(s, m):
-                            want_rollback = True
-                        if not skipped:
-                            # a skipped step's loss is the garbage the guard
-                            # refused to apply — keep it out of the log means
-                            meter.update(m)
-                    pending.clear()
-                    # per-layer-group diagnostics: one small stacked array per
-                    # diag step, published as model_*{group=...} gauges
-                    latest_diag = None
-                    if diag_pending:
-                        for (ds, _), arr in zip(
-                            diag_pending,
-                            jax.device_get([a for _, a in diag_pending]),
-                        ):
-                            publish_group_stats(diag_names, arr)
-                            latest_diag = (ds, stats_dict(diag_names, arr), arr)
-                            if flightrec is not None:
-                                flightrec.record_step(ds, {"diag": latest_diag[1]})
-                        diag_pending.clear()
-                    summary = meter.summary("train/")
-                    if step_cost is None:
-                        execs = getattr(train_step, "executables", None)
-                        if execs:
-                            cost = extract_cost(
-                                next(iter(execs.values())), "train_step"
-                            )
-                            if cost is not None:
-                                step_cost = cost
-                                publish_cost(
-                                    cost,
-                                    bucket="",
-                                    dtype=cfg.model.overrides.get("dtype", ""),
-                                )
-                                _emit(
-                                    "compiled_program",
-                                    batch=run.train_batch_size,
-                                    **cost_asdict(cost),
-                                )
-                            else:
-                                step_cost = False  # backend reported nothing
-                    sps = timer.steps_per_sec
-                    if sps:
-                        imgs = sps * run.train_batch_size
-                        rep = mfu_report(flops_per_image, imgs / n_chips)
-                        summary |= {
-                            "perf/images_per_sec": imgs,
-                            "perf/images_per_sec_per_chip": imgs / n_chips,
-                            "perf/mfu": rep.mfu,
-                            "perf/tflops_per_chip": rep.achieved_tflops,
-                        }
-                        g_mfu.set(rep.mfu)
-                        g_ips.set(imgs)
-                        if step_cost:
-                            # MFU (analytic model flops) vs HFU (XLA-counted,
-                            # remat recompute included) + roofline drift
-                            util = utilization_report(
-                                flops_per_image * run.train_batch_size,
-                                step_cost.flops,
-                                sps,
-                                n_chips=n_chips,
-                                peak_tflops=rep.peak_tflops,
-                            )
-                            pred = roofline(
-                                step_cost.flops,
-                                step_cost.bytes_accessed,
-                                chip,
-                                peak_hbm_bytes=step_cost.peak_bytes,
-                            )
-                            drift = publish_drift(
-                                pred.step_time_s, 1.0 / sps, program="train_step"
-                            )
-                            summary |= {
-                                "perf/model_flops_utilization": rep.mfu,
-                                "perf/hardware_flops_utilization": (
-                                    util.hardware_flops_utilization
-                                ),
-                                "perf/predicted_step_ms": pred.step_time_s * 1e3,
-                                "perf/predict_vs_measured": drift,
-                            }
-                            g_hfu.set(util.hardware_flops_utilization)
-                    now = time.perf_counter()
-                    wait_frac = window_wait / max(now - window_t0, 1e-9)
-                    g_wait_frac.set(wait_frac)
-                    # memory sample BEFORE the beacon write so this window's
-                    # rss/device-peak ride out in this window's beacon
-                    msnap = None
-                    if memwatch is not None:
-                        if step_cost:
-                            memwatch.record_predicted_peak(
-                                "train_step", step_cost.peak_bytes
-                            )
-                        msnap = memwatch.sample()
-                        if "rss_bytes" in msnap:
-                            beacon_stats["rss_bytes"] = int(msnap["rss_bytes"])
-                        if "device_peak_bytes" in msnap:
-                            beacon_stats["device_peak_bytes"] = int(
-                                msnap["device_peak_bytes"]
-                            )
-                        if "note" in msnap:
-                            print(f"[obs] {msnap['note']}")
-                    if beacon is not None:
-                        st = (now - window_t0) / max(window_steps, 1)
-                        step_ema_s = (
-                            st
-                            if step_ema_s is None
-                            else 0.5 * step_ema_s + 0.5 * st
-                        )
-                        bad_total += len(window_bad)
-                        beacon_stats.update(
-                            step_time_ema_s=round(step_ema_s, 4),
-                            data_wait_fraction=round(wait_frac, 4),
-                            shard_retries=int(
-                                reg.counter(
-                                    "data_shard_retries_total",
-                                    "shard reads retried after a "
-                                    "transient failure",
-                                ).value
-                            ),
-                            shard_quarantines=len(QUARANTINE.snapshot()),
-                            sentinel_bad_steps=bad_total,
-                        )
-                        _beacon_write(step)
-                        if fleet_agg is not None:
-                            try:
-                                fleet_agg.scan()
-                            except OSError:
-                                pass
-                    window_t0, window_wait, window_steps = now, 0.0, 0
-                    logger.log(summary, step=step)
-                    last_metrics = summary
+    # -- the run engine (train/engine.py): the driver owns the step loop,
+    # -- log-boundary metric fetch, rollback/preemption control flow, and
+    # -- the crash/shutdown ladder; everything below registers into it ----
+    def _next_batch(step_now: int):
+        nonlocal window_wait
+        with sp_wait:
+            batch = next(train_iter)
+        window_wait += sp_wait.last_s
+        health.beat("data_batch")
+        return batch
 
-                    # durable step snapshot + newly quarantined shards
-                    if journal is not None or flightrec is not None:
-                        snap_ev = {
-                            "step": step,
-                            "metrics": summary,
-                            "data_wait_fraction": round(wait_frac, 4),
-                        }
-                        if window_bad:
-                            snap_ev["bad_steps"] = window_bad
-                        if latest_diag is not None:
-                            snap_ev["diag_step"] = latest_diag[0]
-                            snap_ev["diag"] = latest_diag[1]
-                        _emit("step", **snap_ev)
-                        new_q = set(QUARANTINE.snapshot()) - seen_quarantine
-                        if new_q:
-                            seen_quarantine |= new_q
-                            _emit("quarantine", shards=sorted(new_q))
-                    if msnap is not None:
-                        _emit(
-                            "mem_sample",
-                            step=step,
-                            **{k: v for k, v in msnap.items() if k != "ts"},
-                        )
-                        fired = (
-                            leak_sentinel.observe(msnap)
-                            if leak_sentinel is not None
-                            else None
-                        )
-                        if fired is not None:
-                            _emit("mem_leak_suspect", step=step, **fired)
-                            print(
-                                "[obs] WARNING: leak sentinel fired — "
-                                f"suspect {fired['component']} "
-                                f"(+{fired['robust_growth_bytes'] // (1024 * 1024)}"
-                                f" MiB robust growth over {fired['window']} "
-                                "samples); /healthz degraded"
-                            )
-                            _black_box("mem_leak", **fired)
-                    # black box on the first bad window (edge-triggered: a long
-                    # NaN streak is one incident, not a dump per log boundary)
-                    if window_bad:
-                        if flightrec is not None:
-                            flightrec.mark_abnormal()
-                        if not prev_window_bad:
-                            grp = (
-                                first_nonfinite_group(diag_names, latest_diag[2])
-                                if latest_diag is not None
-                                else None
-                            )
-                            _black_box(
-                                "nonfinite_step",
-                                bad_steps=window_bad,
-                                first_nonfinite_group=grp,
-                            )
-                    prev_window_bad = bool(window_bad)
+    def _dispatch(state_now, batch, step_now: int):
+        # fault sites train.loss / train.grad: traced multipliers into
+        # the step (NaN at chosen invocations, no recompile); the
+        # branch costs nothing when no plan is active
+        inject = None
+        if faults_active():
+            # host.leak chaos site: corrupt(n) retains n MB/step in
+            # the module ballast (the leak sentinel's test fixture);
+            # a raise action models "the leak got fixed" and clears
+            host_leak_tick(key=str(step_now))
+            lm = fault_point("train.loss", key=str(step_now), data=1.0)
+            gm = fault_point("train.grad", key=str(step_now), data=1.0)
+            if (lm, gm) != (1.0, 1.0):
+                inject = np.asarray([lm, gm], np.float32)
+        if retrace_sentinel is not None:
+            retrace_sentinel.note("train_step", batch)
+        with sp_step:
+            if inject is None:
+                state_now, metrics = train_step(state_now, batch)
+            elif retrace_sentinel is not None:
+                # the inject arm is a distinct (legitimate)
+                # executable — its first compile is not a retrace
+                with retrace_sentinel.expected("fault-inject"):
+                    state_now, metrics = train_step(state_now, batch, inject)
+            else:
+                state_now, metrics = train_step(state_now, batch, inject)
+        return state_now, metrics
 
-                    if want_rollback:
-                        # persistent divergence: restore the last checkpoint
-                        # (params + optimizer + RNG + data cursor) and continue
-                        # from there. Skipping alone can't fix a state that is
-                        # already bad — rewinding to a known-good one can.
-                        if ckpt.latest_step("last") is None:
-                            raise DivergenceError(
-                                f"training diverged at step {step} with no "
-                                "checkpoint to roll back to — lower the LR or "
-                                "set run.eval_interval below the failure point"
-                            )
-                        sentinel.record_rollback()  # raises once budget is spent
-                        ckpt.wait()  # a save may still be in flight
-                        state, extra = ckpt.restore(state, sharding=state_sharding)
-                        rolled_from, step = step, int(state.step)
-                        print(
-                            f"[train] sentinel rollback #{sentinel.rollbacks} → "
-                            f"resuming from step {step}"
-                        )
-                        _emit(
-                            "rollback",
-                            from_step=rolled_from,
-                            to_step=step,
-                            rollbacks=sentinel.rollbacks,
-                            bad_steps=window_bad,
-                        )
-                        # every rollback leaves a black box: the per-step ring
-                        # around the divergence, not just the fact of it
-                        _black_box(
-                            "sentinel_rollback",
-                            from_step=rolled_from,
-                            to_step=step,
-                            rollbacks=sentinel.rollbacks,
-                        )
-                        prev_window_bad = False  # restored stream starts clean
-                        if source is not None:
-                            source.close()
-                        train_iter, source, cursor_log = make_train_iterator(
-                            cfg, mesh, per_process, step,
-                            extra.get("data_cursor"),
-                            num_labels=enc_cfg.labels or 1000,
-                        )
-                        continue
+    engine = RunEngine(
+        training_steps=run.training_steps,
+        start_step=start_step,
+        log_interval=run.log_interval,
+        eval_interval=run.eval_interval,
+        process_count=process_count,
+        next_batch=_next_batch,
+        dispatch=_dispatch,
+        should_stop=lambda: _agree_on_preemption(preempt, process_count),
+    )
 
-                saved_this_step = False
-                if step % run.eval_interval == 0 or step == run.training_steps:
-                    snap = _gather_data_cursor(cursor_log.get(step))
-                    extra = {"data_cursor": snap} if snap is not None else None
-                    for k in [k for k in cursor_log if k <= step]:
-                        del cursor_log[k]
-                    if valid_factory is not None:
-                        if retrace_sentinel is not None:
-                            with retrace_sentinel.expected("eval"):
-                                val = evaluate(
-                                    eval_step, state, valid_factory(), pad_batch
-                                )
-                        else:
-                            val = evaluate(eval_step, state, valid_factory(), pad_batch)
-                        logger.log(val, step=step)
-                        last_metrics |= val
-                        with sp_ckpt:
-                            ckpt.save(step, state, metrics=val, extra=extra)
-                    else:
-                        val = None
-                        with sp_ckpt:
-                            ckpt.save(step, state, extra=extra)
-                    saved_this_step = True
-                    _emit(
-                        "checkpoint_save",
-                        step=step,
-                        eval_metrics=val,
-                        save_seconds=round(sp_ckpt.last_s, 3),
-                    )
+    @engine.pre_step
+    def _fleet_component(eng, step_now):
+        # beacon BEFORE the data wait: under synchronous SPMD the
+        # fetched step counts stay lockstep, but a host stuck waiting
+        # on data sits at this step's entry while its peers dispatch
+        # ahead — that dispatch gap is exactly what fleet_step_lag sees
+        nonlocal window_steps
+        _beacon_write(step_now)
+        window_steps += 1
 
-                # Graceful preemption: single-host checks the flag every step;
-                # multi-host only at log/eval boundaries (reaching agreement
-                # needs a host allgather, which would serialize dispatch if done
-                # per step), which is well inside any preemption grace window.
-                boundary = (
-                    process_count == 1
-                    or saved_this_step
-                    or step % run.log_interval == 0
+    @engine.on_step
+    def _telemetry_component(eng, ev):
+        c_steps.inc()
+        g_step.set(ev.step)
+        health.beat("train_step")
+        if retrace_sentinel is not None and ev.step == start_step + 1:
+            retrace_sentinel.arm()  # warmup over: steady state begins
+
+    @engine.on_step
+    def _diag_component(eng, ev):
+        if not diag_on:
+            return
+        # keep the (G,3) stats array OUT of the scalar pending list
+        # (the meter/sentinel consume scalars); fetch it only at the
+        # diag cadence — off-cadence arrays are dropped on device
+        metrics = dict(ev.metrics)
+        diag_dev = metrics.pop("diag")
+        if ev.step % run.diag_every == 0 or ev.step == run.training_steps:
+            diag_pending.append((ev.step, diag_dev))
+        ev.metrics = metrics
+
+    @engine.on_step
+    def _pacing_component(eng, ev):
+        timer.tick()
+        # only cursor_log[step] (and prefetched future steps) are ever
+        # read — prune dead entries every iteration, not just at save
+        # time, or sparse checkpointing grows host memory without bound
+        for k in [k for k in cursor_log if k < ev.step]:
+            del cursor_log[k]
+
+    @engine.on_log_window
+    def _log_window(eng, win):
+        nonlocal step_cost, window_t0, window_wait, window_steps
+        nonlocal bad_total, step_ema_s, prev_window_bad, last_metrics
+        nonlocal seen_quarantine
+        step = win.step
+        window_bad: list[int] = []
+        for (s, m) in win.fetched:
+            skipped = float(m.get("skipped", 0.0)) >= 0.5
+            loss_v = float(m.get("loss", math.nan))
+            if skipped or not math.isfinite(loss_v):
+                window_bad.append(s)
+            gn = m.get("grad_norm")
+            if gn is not None:
+                g_grad_norm.set(float(gn))
+            if flightrec is not None:
+                entry = {"loss": loss_v}
+                if gn is not None:
+                    entry["grad_norm"] = float(gn)
+                if "finite_frac" in m:
+                    entry["finite_frac"] = float(m["finite_frac"])
+                if skipped:
+                    entry["skipped"] = True
+                flightrec.record_step(s, entry)
+            if sentinel is not None and sentinel.observe(s, m):
+                eng.request_rollback()
+            if not skipped:
+                # a skipped step's loss is the garbage the guard
+                # refused to apply — keep it out of the log means
+                meter.update(m)
+        win.bad_steps = window_bad
+        # per-layer-group diagnostics: one small stacked array per
+        # diag step, published as model_*{group=...} gauges
+        latest_diag = None
+        if diag_pending:
+            for (ds, _), arr in zip(
+                diag_pending,
+                jax.device_get([a for _, a in diag_pending]),
+            ):
+                publish_group_stats(diag_names, arr)
+                latest_diag = (ds, stats_dict(diag_names, arr), arr)
+                if flightrec is not None:
+                    flightrec.record_step(ds, {"diag": latest_diag[1]})
+            diag_pending.clear()
+        summary = meter.summary("train/")
+        if step_cost is None:
+            execs = getattr(train_step, "executables", None)
+            if execs:
+                cost = extract_cost(
+                    next(iter(execs.values())), "train_step"
                 )
-                if boundary and _agree_on_preemption(preempt, process_count):
-                    if not saved_this_step:
-                        snap = _gather_data_cursor(cursor_log.get(step))
-                        with sp_ckpt:
-                            ckpt.save(
-                                step,
-                                state,
-                                extra={"data_cursor": snap} if snap is not None else None,
-                            )
-                        _emit("checkpoint_save", step=step, preemption=True)
-                    print(f"[train] preemption checkpoint at step {step}; exiting")
-                    exit_reason = "preempted"
-                    break
-    except BaseException as e:
+                if cost is not None:
+                    step_cost = cost
+                    publish_cost(
+                        cost,
+                        bucket="",
+                        dtype=cfg.model.overrides.get("dtype", ""),
+                    )
+                    _emit(
+                        "compiled_program",
+                        batch=run.train_batch_size,
+                        **cost_asdict(cost),
+                    )
+                else:
+                    step_cost = False  # backend reported nothing
+        sps = timer.steps_per_sec
+        if sps:
+            imgs = sps * run.train_batch_size
+            rep = mfu_report(flops_per_image, imgs / n_chips)
+            summary |= {
+                "perf/images_per_sec": imgs,
+                "perf/images_per_sec_per_chip": imgs / n_chips,
+                "perf/mfu": rep.mfu,
+                "perf/tflops_per_chip": rep.achieved_tflops,
+            }
+            g_mfu.set(rep.mfu)
+            g_ips.set(imgs)
+            if step_cost:
+                # MFU (analytic model flops) vs HFU (XLA-counted,
+                # remat recompute included) + roofline drift
+                util = utilization_report(
+                    flops_per_image * run.train_batch_size,
+                    step_cost.flops,
+                    sps,
+                    n_chips=n_chips,
+                    peak_tflops=rep.peak_tflops,
+                )
+                pred = roofline(
+                    step_cost.flops,
+                    step_cost.bytes_accessed,
+                    chip,
+                    peak_hbm_bytes=step_cost.peak_bytes,
+                )
+                drift = publish_drift(
+                    pred.step_time_s, 1.0 / sps, program="train_step"
+                )
+                summary |= {
+                    "perf/model_flops_utilization": rep.mfu,
+                    "perf/hardware_flops_utilization": (
+                        util.hardware_flops_utilization
+                    ),
+                    "perf/predicted_step_ms": pred.step_time_s * 1e3,
+                    "perf/predict_vs_measured": drift,
+                }
+                g_hfu.set(util.hardware_flops_utilization)
+        now = time.perf_counter()
+        wait_frac = window_wait / max(now - window_t0, 1e-9)
+        g_wait_frac.set(wait_frac)
+        # memory sample BEFORE the beacon write so this window's
+        # rss/device-peak ride out in this window's beacon
+        msnap = None
+        if memwatch is not None:
+            if step_cost:
+                memwatch.record_predicted_peak(
+                    "train_step", step_cost.peak_bytes
+                )
+            msnap = memwatch.sample()
+            if "rss_bytes" in msnap:
+                beacon_stats["rss_bytes"] = int(msnap["rss_bytes"])
+            if "device_peak_bytes" in msnap:
+                beacon_stats["device_peak_bytes"] = int(
+                    msnap["device_peak_bytes"]
+                )
+            if "note" in msnap:
+                print(f"[obs] {msnap['note']}")
+        if beacon is not None:
+            st = (now - window_t0) / max(window_steps, 1)
+            step_ema_s = (
+                st
+                if step_ema_s is None
+                else 0.5 * step_ema_s + 0.5 * st
+            )
+            bad_total += len(window_bad)
+            beacon_stats.update(
+                step_time_ema_s=round(step_ema_s, 4),
+                data_wait_fraction=round(wait_frac, 4),
+                shard_retries=int(
+                    reg.counter(
+                        "data_shard_retries_total",
+                        "shard reads retried after a "
+                        "transient failure",
+                    ).value
+                ),
+                shard_quarantines=len(QUARANTINE.snapshot()),
+                sentinel_bad_steps=bad_total,
+            )
+            _beacon_write(step)
+            if fleet_agg is not None:
+                try:
+                    fleet_agg.scan()
+                except OSError:
+                    pass
+        window_t0, window_wait, window_steps = now, 0.0, 0
+        logger.log(summary, step=step)
+        last_metrics = summary
+        win.summary = summary
+
+        # durable step snapshot + newly quarantined shards
+        if journal is not None or flightrec is not None:
+            snap_ev = {
+                "step": step,
+                "metrics": summary,
+                "data_wait_fraction": round(wait_frac, 4),
+            }
+            if window_bad:
+                snap_ev["bad_steps"] = window_bad
+            if latest_diag is not None:
+                snap_ev["diag_step"] = latest_diag[0]
+                snap_ev["diag"] = latest_diag[1]
+            _emit("step", **snap_ev)
+            new_q = set(QUARANTINE.snapshot()) - seen_quarantine
+            if new_q:
+                seen_quarantine |= new_q
+                _emit("quarantine", shards=sorted(new_q))
+        if msnap is not None:
+            _emit(
+                "mem_sample",
+                step=step,
+                **{k: v for k, v in msnap.items() if k != "ts"},
+            )
+            fired = (
+                leak_sentinel.observe(msnap)
+                if leak_sentinel is not None
+                else None
+            )
+            if fired is not None:
+                _emit("mem_leak_suspect", step=step, **fired)
+                print(
+                    "[obs] WARNING: leak sentinel fired — "
+                    f"suspect {fired['component']} "
+                    f"(+{fired['robust_growth_bytes'] // (1024 * 1024)}"
+                    f" MiB robust growth over {fired['window']} "
+                    "samples); /healthz degraded"
+                )
+                _black_box("mem_leak", **fired)
+        # black box on the first bad window (edge-triggered: a long
+        # NaN streak is one incident, not a dump per log boundary)
+        if window_bad:
+            if flightrec is not None:
+                flightrec.mark_abnormal()
+            if not prev_window_bad:
+                grp = (
+                    first_nonfinite_group(diag_names, latest_diag[2])
+                    if latest_diag is not None
+                    else None
+                )
+                _black_box(
+                    "nonfinite_step",
+                    bad_steps=window_bad,
+                    first_nonfinite_group=grp,
+                )
+        prev_window_bad = bool(window_bad)
+
+    @engine.on_rollback
+    def _rollback(eng, step, win):
+        # persistent divergence: restore the last checkpoint
+        # (params + optimizer + RNG + data cursor) and continue
+        # from there. Skipping alone can't fix a state that is
+        # already bad — rewinding to a known-good one can.
+        nonlocal train_iter, source, cursor_log, prev_window_bad
+        if ckpt.latest_step("last") is None:
+            raise DivergenceError(
+                f"training diverged at step {step} with no "
+                "checkpoint to roll back to — lower the LR or "
+                "set run.eval_interval below the failure point"
+            )
+        sentinel.record_rollback()  # raises once budget is spent
+        ckpt.wait()  # a save may still be in flight
+        eng.state, extra = ckpt.restore(eng.state, sharding=state_sharding)
+        rolled_from, new_step = step, int(eng.state.step)
+        print(
+            f"[train] sentinel rollback #{sentinel.rollbacks} → "
+            f"resuming from step {new_step}"
+        )
+        _emit(
+            "rollback",
+            from_step=rolled_from,
+            to_step=new_step,
+            rollbacks=sentinel.rollbacks,
+            bad_steps=win.bad_steps,
+        )
+        # every rollback leaves a black box: the per-step ring
+        # around the divergence, not just the fact of it
+        _black_box(
+            "sentinel_rollback",
+            from_step=rolled_from,
+            to_step=new_step,
+            rollbacks=sentinel.rollbacks,
+        )
+        prev_window_bad = False  # restored stream starts clean
+        if source is not None:
+            source.close()
+        train_iter, source, cursor_log = make_train_iterator(
+            cfg, mesh, per_process, new_step,
+            extra.get("data_cursor"),
+            num_labels=enc_cfg.labels or 1000,
+        )
+        return new_step
+
+    @engine.on_eval
+    def _eval_component(eng, step, state_now):
+        nonlocal last_metrics
+        if valid_factory is None:
+            return None
+        if retrace_sentinel is not None:
+            with retrace_sentinel.expected("eval"):
+                val = evaluate(eval_step, state_now, valid_factory(), pad_batch)
+        else:
+            val = evaluate(eval_step, state_now, valid_factory(), pad_batch)
+        logger.log(val, step=step)
+        last_metrics |= val
+        return val
+
+    @engine.on_checkpoint
+    def _checkpoint_component(eng, cev):
+        step = cev.step
+        if cev.reason == "preemption":
+            snap = _gather_data_cursor(cursor_log.get(step))
+            with sp_ckpt:
+                ckpt.save(
+                    step,
+                    eng.state,
+                    extra={"data_cursor": snap} if snap is not None else None,
+                )
+            _emit("checkpoint_save", step=step, preemption=True)
+            return
+        snap = _gather_data_cursor(cursor_log.get(step))
+        extra = {"data_cursor": snap} if snap is not None else None
+        for k in [k for k in cursor_log if k <= step]:
+            del cursor_log[k]
+        with sp_ckpt:
+            ckpt.save(step, eng.state, metrics=cev.metrics, extra=extra)
+        cev.save_seconds = round(sp_ckpt.last_s, 3)
+        _emit(
+            "checkpoint_save",
+            step=step,
+            eval_metrics=cev.metrics,
+            save_seconds=cev.save_seconds,
+        )
+
+    @engine.on_crash
+    def _crash_component(eng, exc):
         # the black box is most valuable exactly here: the run is dying and
         # the in-memory ring is about to vanish
-        exit_reason = (
+        eng.exit_reason = (
             "diverged"
-            if isinstance(e, DivergenceError)
-            else f"exception:{type(e).__name__}"
+            if isinstance(exc, DivergenceError)
+            else f"exception:{type(exc).__name__}"
         )
         if flightrec is not None:
             try:
                 flightrec.dump(
-                    "exception", extra={"error": f"{type(e).__name__}: {e}"}
+                    "exception", extra={"error": f"{type(exc).__name__}: {exc}"}
                 )
             except Exception:  # noqa: BLE001 - never mask the real failure
                 pass
-        raise
-    finally:
+
+    @engine.on_shutdown
+    def _retrace_shutdown(eng, reason, step):
         if retrace_sentinel is not None:
             rsum = retrace_sentinel.summary()
             print(
@@ -1299,12 +1321,41 @@ def train(cfg: TrainConfig) -> dict:
                 f"{rsum['expected']} expected)"
             )
             retrace_sentinel.close()
-        _emit("shutdown", reason=exit_reason, step=step)
+
+    @engine.on_shutdown
+    def _journal_shutdown(eng, reason, step):
+        _emit("shutdown", reason=reason, step=step)
         _beacon_write(step)  # final heartbeat: a clean exit is not a lost host
         if flightrec is not None:
             flightrec.uninstall()
         if journal is not None:
             journal.close()
+
+    # continuous deployment (serve/publisher.py): gate-passing checkpoints
+    # export int8/delta artifacts into the swap-watch dir the serving
+    # tier polls; host 0 only (the export fetches the full tree to host)
+    publisher = None
+    if run.publish_dir and is_main:
+        from jumbo_mae_tpu_tpu.serve.publisher import CheckpointPublisher
+
+        publisher = CheckpointPublisher(
+            run.publish_dir,
+            quant=run.publish_quant,
+            min_interval_steps=run.publish_min_interval_steps,
+            full_every=run.publish_full_every,
+            metric_key=run.publish_metric_key,
+            metric_floor=run.publish_metric_floor,
+            metric_sense=run.publish_metric_sense,
+            emit=_emit,
+        )
+        publisher.register(engine)
+        print(f"[publish] gated weights publisher -> {run.publish_dir}")
+
+    try:
+        with trace(run.profile_dir or None):
+            engine.run(state)
+    finally:
+        state = engine.state
 
     ckpt.wait()
     ckpt.close()

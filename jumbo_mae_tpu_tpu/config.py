@@ -149,6 +149,24 @@ class RunConfig:
     slo_window_s: float = 60.0
     slo_fast_window_s: float = 0.0
     slo_burn_threshold: float = 1.0
+    # continuous deployment (serve/publisher.py): publish_dir non-empty
+    # turns on the gated train→serve weights publisher — every checkpoint
+    # that passes the gates (finite-loss window since the last save,
+    # sentinel-clean, at least publish_min_interval_steps since the last
+    # publish, and — when publish_metric_key is set — the eval metric
+    # above/below publish_metric_floor per publish_metric_sense) is
+    # exported as an inference-ready artifact into publish_dir (the
+    # directory `predict --swap-watch` polls). publish_quant "int8"
+    # quantizes matmul weights at publish time (infer/quant.py);
+    # "none" ships f32. Deltas ride against the last published tree;
+    # a full tree is forced every publish_full_every artifacts.
+    publish_dir: str = ""
+    publish_quant: str = "int8"
+    publish_min_interval_steps: int = 0
+    publish_full_every: int = 8
+    publish_metric_key: str = ""
+    publish_metric_floor: float = 0.0
+    publish_metric_sense: str = "below"
     # write the host-side span timeline (chrome://tracing / Perfetto JSON)
     # here at the end of the run; complements profile_dir's XLA device trace
     chrome_trace: str = ""

@@ -56,7 +56,7 @@ else ``jax.process_index()`` when jax is already imported, else 0.
 Known sites (free-form names are allowed; these are the wired ones):
 ``data.shard_open``, ``data.decode``, ``train.loss``, ``train.grad``,
 ``serve.submit``, ``serve.replica``, ``serve.preempt``, ``ckpt.save``,
-``ckpt.load``, ``host.leak``, ``batch.worker``.
+``ckpt.load``, ``host.leak``, ``batch.worker``, ``publish.export``.
 
 ``serve.replica`` fires at the top of each replica's batched predict with
 ``key`` = the replica name (``r0``, ``r1``, …), so ``key~`` targets one
@@ -78,6 +78,11 @@ ballast list each time it fires (a controllable host leak the
 ``LeakSentinel`` must catch and attribute), ``raise`` clears the ballast
 (the "leak fixed" edge); :func:`leak_ballast_bytes` is the accounting
 probe `obs/memwatch.py` registers so the attribution is testable.
+``publish.export`` fires in the weights publisher's export
+(``serve/publisher.py``) with the payload bytes as ``data``, *after* the
+manifest's digests are sealed: ``corrupt(k)`` ships a poisoned artifact
+the watcher's manifest verification must quarantine, ``raise`` models a
+torn export (nothing commits — the atomic-rename contract under test).
 """
 
 from __future__ import annotations
@@ -119,6 +124,7 @@ KNOWN_SITES = (
     "ckpt.load",
     "host.leak",
     "batch.worker",
+    "publish.export",
 )
 
 

@@ -369,10 +369,23 @@ class InferenceEngine:
         self._ckpt_tree: dict | None = None
         self._ckpt_stats: dict | None = None
         if self._ckpt:
-            # to_device: leaves land on device one at a time, host buffers
-            # dropped as they go — replica-density restore (peak one tree,
-            # not host + device copies of a full model)
-            tree, stats = restore_inference_state(self._ckpt, to_device=True)
+            from jumbo_mae_tpu_tpu.serve.publisher import is_publish_artifact
+
+            if is_publish_artifact(self._ckpt):
+                # a published train→serve artifact (serve/publisher.py):
+                # verify the manifest, resolve its delta chain to a full
+                # host tree — a pool can cold-start straight from the
+                # newest publish and absorb later ones via hot-swap
+                from jumbo_mae_tpu_tpu.serve.publisher import resolve_chain
+
+                tree, stats = resolve_chain(self._ckpt)[:2]
+            else:
+                # to_device: leaves land on device one at a time, host
+                # buffers dropped as they go — replica-density restore
+                # (peak one tree, not host + device copies of a full model)
+                tree, stats = restore_inference_state(
+                    self._ckpt, to_device=True
+                )
             self._ckpt_tree = _to_state_dict(tree)
             self._ckpt_stats = (
                 _to_state_dict(stats) if stats is not None else None

@@ -87,6 +87,9 @@ JOURNAL_EVENTS = frozenset(
         "job_cursor",
         "job_shard_done",
         "job_complete",
+        "publish",
+        "publish_skipped",
+        "publish_failed",
     }
 )
 

@@ -24,6 +24,11 @@ Four cooperating components (ROADMAP open item 2 — the gap between
   attributed separately), feeding ``serve_tenant_*`` metrics, per-row
   ``device_ms``/``cost_flops`` access-log columns, ``tenant_usage``
   journal events, and the admission gate's ``budget=`` enforcement.
+- :mod:`~jumbo_mae_tpu_tpu.serve.publisher` — continuous deployment:
+  the gated train→serve weights publisher (int8/delta artifacts with a
+  verifiable manifest chain into the ``--swap-watch`` directory) and the
+  verification/resolution helpers the swap watcher and
+  ``tools/publish_doctor.py`` share.
 """
 
 from jumbo_mae_tpu_tpu.serve.admission import (
@@ -37,14 +42,28 @@ from jumbo_mae_tpu_tpu.serve.admission import (
 )
 from jumbo_mae_tpu_tpu.serve.autoscaler import Autoscaler, roofline_capacity
 from jumbo_mae_tpu_tpu.serve.costmeter import CostMeter, default_cost_fn
+from jumbo_mae_tpu_tpu.serve.publisher import (
+    CheckpointPublisher,
+    PublishIntegrityError,
+    is_publish_artifact,
+    latest_artifact,
+    resolve_chain,
+    verify_artifact,
+)
 from jumbo_mae_tpu_tpu.serve.scheduler import ContinuousScheduler
 
 __all__ = [
     "CLASSES",
     "AdmissionController",
     "Autoscaler",
+    "CheckpointPublisher",
     "ContinuousScheduler",
     "CostMeter",
+    "PublishIntegrityError",
+    "is_publish_artifact",
+    "latest_artifact",
+    "resolve_chain",
+    "verify_artifact",
     "TenantBudgetError",
     "TenantPressureError",
     "TenantQuotaError",

@@ -1,0 +1,595 @@
+"""Gated train→serve weights publisher + artifact verification.
+
+The serving tier already polls a ``--swap-watch`` directory and hot-swaps
+whatever lands there (``serve/replicaset.py``'s ``WeightSwapController``
+runs restore → parity → canary → promote). Nothing ever *fed* that
+directory from training. This module closes the loop:
+
+- :class:`CheckpointPublisher` registers on the :class:`~jumbo_mae_tpu_tpu.
+  train.engine.RunEngine` checkpoint hook. Every interval checkpoint that
+  passes the gates — a finite-loss window since the last save, no sentinel
+  rollback since the last save, at least ``min_interval_steps`` since the
+  last publish, and (optionally) an eval metric above/below a floor — is
+  exported as an inference-ready artifact into the watch directory.
+- Export is **int8 PTQ at publish time** (``infer/quant.py``): the serving
+  tier's HBM-bandwidth-bound shapes want int8 anyway, so quantize once on
+  the training host instead of on every replica restore. ``quant="none"``
+  ships f32.
+- Transport is **delta against the last published tree**: only leaves whose
+  (quantized) bytes changed ride in the payload; the manifest records every
+  leaf's digest and whether it lives in this artifact or the base, plus the
+  base's name and tree fingerprint, forming a resolvable chain. A full tree
+  is forced every ``full_every`` publishes so chains stay bounded.
+- Commit is **atomic**: everything is staged in a dot-prefixed tmp dir
+  (invisible to the watcher, which skips dotted names), fsync'd, then
+  ``os.replace``'d into place + :func:`~jumbo_mae_tpu_tpu.obs.journal.
+  fsync_dir` — a torn export can never present a partial artifact.
+- The manifest carries a **parity fingerprint** (sha256 over every leaf's
+  digest): :func:`verify_artifact` / :func:`resolve_chain` recompute it
+  before any bytes reach a live model, so a poisoned or torn artifact is
+  quarantined at the watcher, not discovered by the parity gate after a
+  restore. The ``publish.export`` fault site injects exactly those
+  corruptions for the chaos suite.
+- Publish device-time is billed to a dedicated ``publish`` tenant through
+  :class:`~jumbo_mae_tpu_tpu.serve.costmeter.CostMeter`, so continuous
+  deployment shows up in the chargeback (``tools/cost_doctor.py``), not as
+  noise.
+
+Artifact layout (one directory per publish, names sort in publish order)::
+
+    <publish_dir>/publish-000007/
+        manifest.json       # schema, step, leaf digests, chain link, gates
+        weights.msgpack     # flax msgpack: {path: {kind, q, scale} | {kind, v}}
+
+Offline verification lives in ``tools/publish_doctor.py``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import re
+import shutil
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+
+from jumbo_mae_tpu_tpu.faults import fault_point
+from jumbo_mae_tpu_tpu.obs.journal import fsync_dir
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+
+SCHEMA = 1
+MANIFEST = "manifest.json"
+PAYLOAD = "weights.msgpack"
+_NAME_RE = re.compile(r"^publish-(\d{6})$")
+
+
+class PublishIntegrityError(RuntimeError):
+    """An artifact failed verification: torn write, corrupted payload,
+    fingerprint mismatch, or a broken/cyclic delta chain. The watcher
+    quarantines on this — it must never crash the serving process."""
+
+
+# --------------------------------------------------------------- tree codec
+
+
+def _flatten(node, prefix: str, out: dict) -> None:
+    from jumbo_mae_tpu_tpu.infer.quant import QuantizedTensor
+
+    if node is None:
+        return
+    if isinstance(node, QuantizedTensor):
+        out[prefix] = node
+    elif isinstance(node, dict):
+        for k in sorted(node):
+            _flatten(node[k], f"{prefix}/{k}" if prefix else str(k), out)
+    else:
+        out[prefix] = np.asarray(node)
+
+
+def _unflatten(leaves: dict) -> dict:
+    tree: dict = {}
+    for path, leaf in leaves.items():
+        parts = path.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return tree
+
+
+def _encode_leaf(leaf) -> dict:
+    from jumbo_mae_tpu_tpu.infer.quant import QuantizedTensor
+
+    if isinstance(leaf, QuantizedTensor):
+        return {
+            "kind": "q8",
+            "q": np.asarray(leaf.q),
+            "scale": np.asarray(leaf.scale),
+        }
+    return {"kind": "raw", "v": np.asarray(leaf)}
+
+
+def _decode_leaf(entry: dict, dtype: str):
+    if entry["kind"] == "q8":
+        q = np.asarray(entry["q"], np.float32)
+        return (q * np.asarray(entry["scale"], np.float32)).astype(dtype)
+    return np.asarray(entry["v"])
+
+
+def _leaf_digest(entry: dict) -> str:
+    h = hashlib.sha256()
+    h.update(entry["kind"].encode())
+    for part in ("q", "scale", "v"):
+        arr = entry.get(part)
+        if arr is not None:
+            arr = np.ascontiguousarray(arr)
+            h.update(f"|{part}:{arr.dtype}:{arr.shape}|".encode())
+            h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def tree_fingerprint(digests: dict) -> str:
+    """The parity fingerprint: sha256 over every leaf's ``path:digest``
+    line, sorted — identical trees fingerprint identically regardless of
+    which chain link physically carries each leaf."""
+    h = hashlib.sha256()
+    for path in sorted(digests):
+        h.update(f"{path}:{digests[path]}\n".encode())
+    return h.hexdigest()
+
+
+# ----------------------------------------------------------------- read side
+
+
+def is_publish_artifact(path) -> bool:
+    """A directory containing a publish manifest (vs a raw checkpoint)."""
+    p = Path(path)
+    return p.is_dir() and (p / MANIFEST).is_file()
+
+
+def load_manifest(path) -> dict:
+    p = Path(path) / MANIFEST
+    try:
+        m = json.loads(p.read_text())
+    except (OSError, ValueError) as e:
+        raise PublishIntegrityError(f"{path}: unreadable manifest ({e})") from e
+    if m.get("schema") != SCHEMA or m.get("kind") != "jumbo-publish":
+        raise PublishIntegrityError(
+            f"{path}: not a publish artifact (schema={m.get('schema')!r})"
+        )
+    return m
+
+
+def verify_artifact(path) -> tuple[dict, dict]:
+    """Verify ONE chain link: payload sha256/size match the manifest, every
+    payload leaf's recomputed digest matches its manifest row. Returns
+    ``(manifest, payload_leaves)``; raises :class:`PublishIntegrityError`
+    on any mismatch — before any byte can reach a live model."""
+    from flax import serialization
+
+    p = Path(path)
+    m = load_manifest(p)
+    pay = p / m["payload"]["file"]
+    try:
+        raw = pay.read_bytes()
+    except OSError as e:
+        raise PublishIntegrityError(f"{path}: unreadable payload ({e})") from e
+    if len(raw) != int(m["payload"]["nbytes"]):
+        raise PublishIntegrityError(
+            f"{path}: torn payload ({len(raw)} bytes, manifest says "
+            f"{m['payload']['nbytes']})"
+        )
+    sha = hashlib.sha256(raw).hexdigest()
+    if sha != m["payload"]["sha256"]:
+        raise PublishIntegrityError(
+            f"{path}: payload sha256 mismatch (corrupted artifact)"
+        )
+    try:
+        leaves = serialization.msgpack_restore(raw)
+    except Exception as e:  # noqa: BLE001 - any decode failure is integrity
+        raise PublishIntegrityError(f"{path}: undecodable payload ({e})") from e
+    rows = m["leaves"]
+    for lp, entry in leaves.items():
+        row = rows.get(lp)
+        if row is None or row["where"] != "payload":
+            raise PublishIntegrityError(f"{path}: stray payload leaf {lp!r}")
+        if _leaf_digest(entry) != row["digest"]:
+            raise PublishIntegrityError(
+                f"{path}: leaf {lp!r} digest mismatch (corrupted artifact)"
+            )
+    missing = [
+        lp for lp, row in rows.items()
+        if row["where"] == "payload" and lp not in leaves
+    ]
+    if missing:
+        raise PublishIntegrityError(
+            f"{path}: payload missing manifest leaves {missing[:3]}"
+        )
+    return m, leaves
+
+
+def resolve_chain(path, *, max_depth: int = 64) -> tuple[dict, dict | None, dict]:
+    """Resolve a (possibly delta) artifact to a full dequantized tree.
+
+    Walks base links, verifying every visited link and the recorded base
+    fingerprints, then recomputes the resolved tree's fingerprint against
+    the head manifest — a leaf silently swapped anywhere in the chain fails
+    here. Returns ``(params, batch_stats_or_None, head_manifest)`` with f32
+    leaves ready for :class:`WeightSwapController`'s restore path.
+    """
+    head = Path(path)
+    m, leaves = verify_artifact(head)
+    need = {lp for lp, row in m["leaves"].items() if row["where"] == "base"}
+    cur, cur_dir = m, head
+    depth = 0
+    while need:
+        base = cur.get("base")
+        if not base:
+            raise PublishIntegrityError(
+                f"{cur_dir}: {len(need)} leaves unresolved and no base link"
+            )
+        depth += 1
+        if depth > max_depth:
+            raise PublishIntegrityError(
+                f"{path}: delta chain deeper than {max_depth} (cycle?)"
+            )
+        bdir = head.parent / base["name"]
+        if not bdir.is_dir():
+            raise PublishIntegrityError(
+                f"{cur_dir}: base {base['name']!r} is missing (broken chain)"
+            )
+        bm, bleaves = verify_artifact(bdir)
+        if bm["fingerprint"] != base["fingerprint"]:
+            raise PublishIntegrityError(
+                f"{cur_dir}: base {base['name']!r} fingerprint mismatch "
+                "(chain link was replaced)"
+            )
+        for lp in list(need):
+            if lp in bleaves:
+                leaves[lp] = bleaves[lp]
+                need.discard(lp)
+        cur, cur_dir = bm, bdir
+    digests = {lp: _leaf_digest(entry) for lp, entry in leaves.items()}
+    fp = tree_fingerprint(digests)
+    if fp != m["fingerprint"]:
+        raise PublishIntegrityError(
+            f"{path}: resolved tree fingerprint {fp[:12]} != manifest "
+            f"{m['fingerprint'][:12]}"
+        )
+    for lp, row in m["leaves"].items():
+        if digests.get(lp) != row["digest"]:
+            raise PublishIntegrityError(
+                f"{path}: resolved leaf {lp!r} digest mismatch"
+            )
+    decoded = {
+        lp: _decode_leaf(entry, m["leaves"][lp]["dtype"])
+        for lp, entry in leaves.items()
+    }
+    tree = _unflatten(decoded)
+    return tree.get("params", {}), tree.get("batch_stats"), m
+
+
+def latest_artifact(publish_dir) -> Path | None:
+    """The newest ``publish-NNNNNN`` entry, or None. Dot-prefixed staging
+    dirs are invisible by construction."""
+    d = Path(publish_dir)
+    if not d.is_dir():
+        return None
+    names = sorted(n for n in os.listdir(d) if _NAME_RE.match(n))
+    return d / names[-1] if names else None
+
+
+# ---------------------------------------------------------------- write side
+
+
+class CheckpointPublisher:
+    """The train-side publish component (see module docstring).
+
+    Register on a :class:`RunEngine` via :meth:`register` *after* the
+    checkpoint saver so the save has landed when the publish hook runs.
+    Export failures (including injected ``publish.export`` faults) journal
+    ``publish_failed`` and never propagate — continuous deployment must not
+    be able to kill training.
+    """
+
+    def __init__(
+        self,
+        publish_dir,
+        *,
+        quant: str = "int8",
+        min_interval_steps: int = 0,
+        full_every: int = 8,
+        metric_key: str = "",
+        metric_floor: float = 0.0,
+        metric_sense: str = "below",
+        emit=None,
+        registry=None,
+        clock=time.perf_counter,
+    ):
+        if quant not in ("int8", "none"):
+            raise ValueError(f"publish quant must be int8|none, got {quant!r}")
+        if metric_sense not in ("above", "below"):
+            raise ValueError(
+                f"publish metric sense must be above|below, got {metric_sense!r}"
+            )
+        self.publish_dir = Path(publish_dir)
+        self.quant = quant
+        self.min_interval_steps = int(min_interval_steps)
+        self.full_every = max(1, int(full_every))
+        self.metric_key = metric_key
+        self.metric_floor = float(metric_floor)
+        self.metric_sense = metric_sense
+        self._emit = emit
+        self._clock = clock
+        reg = registry if registry is not None else get_registry()
+        self._m_published = reg.counter(
+            "publish_total", "artifacts published to the swap-watch dir"
+        )
+        self._m_failed = reg.counter(
+            "publish_failed_total", "publish exports that failed"
+        )
+        self._m_rejected = reg.counter(
+            "publish_gate_rejections_total",
+            "checkpoints the publish gates rejected",
+            labels=("reason",),
+        )
+        self._g_bytes = reg.gauge(
+            "publish_bytes", "payload bytes of the last published artifact"
+        )
+        self._g_delta = reg.gauge(
+            "publish_delta_fraction",
+            "fraction of leaves shipped (vs riding the base) last publish",
+        )
+        self._g_seconds = reg.gauge(
+            "publish_seconds", "wall seconds of the last publish export"
+        )
+        # the publish tenant: export wall-time billed through the costmeter
+        # so continuous deployment appears in the chargeback by name
+        self._meter = None
+        if emit is not None:
+            from jumbo_mae_tpu_tpu.serve.admission import TenantSpec
+            from jumbo_mae_tpu_tpu.serve.costmeter import CostMeter
+
+            self._meter = CostMeter(
+                (TenantSpec(name="publish", tclass="batch"),),
+                tracer=SimpleNamespace(event=emit),
+                registry=reg,
+            )
+        self._bad_since_ckpt = 0
+        self._rollback_since_ckpt = False
+        self._last_published_step: int | None = None
+        # resume the chain across restarts: the newest valid on-disk
+        # artifact is the delta base and names the next sequence number
+        self._seq = 0
+        self._base: tuple[str, str, dict] | None = None  # (name, fp, digests)
+        prev = latest_artifact(self.publish_dir)
+        if prev is not None:
+            try:
+                pm = load_manifest(prev)
+                self._seq = int(_NAME_RE.match(prev.name).group(1)) + 1
+                self._base = (
+                    prev.name,
+                    pm["fingerprint"],
+                    {lp: row["digest"] for lp, row in pm["leaves"].items()},
+                )
+            except PublishIntegrityError:
+                self._seq = int(_NAME_RE.match(prev.name).group(1)) + 1
+
+    # -- engine hooks ----------------------------------------------------
+    def register(self, engine) -> None:
+        engine.on_log_window(self._note_window)
+        engine.on_rollback(self._note_rollback)
+        engine.on_checkpoint(self._on_checkpoint)
+
+    def _note_window(self, eng, win) -> None:
+        self._bad_since_ckpt += len(getattr(win, "bad_steps", ()))
+
+    def _note_rollback(self, eng, step, win):
+        self._rollback_since_ckpt = True
+        return None  # the restore hook owns the resumed step
+
+    def _on_checkpoint(self, eng, cev) -> None:
+        if cev.reason != "interval":
+            return  # preemption save: never stand between SIGTERM and exit
+        bad, rolled = self._bad_since_ckpt, self._rollback_since_ckpt
+        self._bad_since_ckpt = 0
+        self._rollback_since_ckpt = False
+        reason = self._gate(cev.step, cev.metrics, bad, rolled)
+        if reason is not None:
+            self._m_rejected.labels(reason).inc()
+            if self._emit is not None:
+                self._emit("publish_skipped", step=cev.step, reason=reason)
+            return
+        try:
+            self.publish(
+                cev.step,
+                eng.state.params,
+                batch_stats=getattr(eng.state, "batch_stats", None),
+                metrics=cev.metrics,
+            )
+        except Exception as e:  # noqa: BLE001 - publish must not kill training
+            self._m_failed.inc()
+            if self._emit is not None:
+                self._emit(
+                    "publish_failed",
+                    step=cev.step,
+                    error=f"{type(e).__name__}: {e}",
+                )
+            print(f"[publish] WARNING: export failed at step {cev.step}: {e}")
+
+    def _gate(self, step, metrics, bad, rolled) -> str | None:
+        """None = publish; otherwise the rejection reason."""
+        if bad:
+            return "bad_steps"
+        if rolled:
+            return "rollback"
+        if (
+            self._last_published_step is not None
+            and step - self._last_published_step < self.min_interval_steps
+        ):
+            return "min_interval"
+        if self.metric_key:
+            val = (metrics or {}).get(self.metric_key)
+            if val is None:
+                return "metric_missing"
+            val = float(val)
+            if not math.isfinite(val):
+                return "metric_nonfinite"
+            ok = (
+                val >= self.metric_floor
+                if self.metric_sense == "above"
+                else val <= self.metric_floor
+            )
+            if not ok:
+                return "metric_floor"
+        return None
+
+    # -- the export ------------------------------------------------------
+    def publish(self, step, params, *, batch_stats=None, metrics=None) -> Path:
+        """Export one artifact (gates already passed). Raises on failure;
+        :meth:`_on_checkpoint` converts that into ``publish_failed``."""
+        import jax
+        from flax import serialization
+
+        t0 = self._clock()
+        host = jax.device_get(serialization.to_state_dict(params))
+        quant_report = None
+        if self.quant == "int8":
+            from jumbo_mae_tpu_tpu.infer.quant import quantize_params
+
+            host, quant_report = quantize_params(host)
+        flat: dict = {}
+        _flatten(host, "params", flat)
+        if batch_stats is not None:
+            _flatten(
+                jax.device_get(serialization.to_state_dict(batch_stats)),
+                "batch_stats",
+                flat,
+            )
+        entries = {lp: _encode_leaf(leaf) for lp, leaf in flat.items()}
+        digests = {lp: _leaf_digest(e) for lp, e in entries.items()}
+        fingerprint = tree_fingerprint(digests)
+
+        # delta vs the last published tree; forced full every full_every
+        # publishes (and whenever the base is missing a needed leaf)
+        base = None
+        in_payload = set(entries)
+        if self._base is not None and self._seq % self.full_every != 0:
+            bname, bfp, bdig = self._base
+            carried = {
+                lp for lp in entries
+                if bdig.get(lp) == digests[lp]
+            }
+            if carried:
+                in_payload = set(entries) - carried
+                base = {"name": bname, "fingerprint": bfp}
+
+        name = f"publish-{self._seq:06d}"
+        payload_tree = {lp: entries[lp] for lp in sorted(in_payload)}
+        payload = serialization.msgpack_serialize(payload_tree)
+        sha = hashlib.sha256(payload).hexdigest()
+        # chaos site: corrupt() poisons the committed bytes AFTER the
+        # manifest digests are sealed (the watcher must catch it); raise
+        # models a torn export (staging dir cleaned up, nothing ships)
+        payload = fault_point("publish.export", key=str(step), data=payload)
+        manifest = {
+            "schema": SCHEMA,
+            "kind": "jumbo-publish",
+            "name": name,
+            "step": int(step),
+            "quant": self.quant,
+            "fingerprint": fingerprint,
+            "base": base,
+            "payload": {"file": PAYLOAD, "sha256": sha, "nbytes": len(payload)},
+            "leaves": {
+                lp: {
+                    "digest": digests[lp],
+                    "kind": entries[lp]["kind"],
+                    "shape": list(np.asarray(flat[lp].q if entries[lp]["kind"] == "q8" else flat[lp]).shape),
+                    "dtype": "float32"
+                    if entries[lp]["kind"] == "q8"
+                    else str(np.asarray(flat[lp]).dtype),
+                    "where": "payload" if lp in in_payload else "base",
+                }
+                for lp in sorted(entries)
+            },
+            "delta_fraction": round(len(in_payload) / max(len(entries), 1), 4),
+            "quant_report": quant_report,
+            "gate": {
+                "metric_key": self.metric_key or None,
+                "metrics": {k: v for k, v in (metrics or {}).items()},
+            },
+        }
+
+        self.publish_dir.mkdir(parents=True, exist_ok=True)
+        tmp = self.publish_dir / f".tmp-{name}"
+        final = self.publish_dir / name
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir()
+        try:
+            for fname, data in (
+                (PAYLOAD, payload),
+                (MANIFEST, json.dumps(manifest, indent=1).encode()),
+            ):
+                fp = tmp / fname
+                with open(fp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+            fsync_dir(tmp)
+            os.replace(tmp, final)  # atomic: the watcher sees all or nothing
+            fsync_dir(self.publish_dir)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+        dt = self._clock() - t0
+        self._seq += 1
+        self._base = (name, fingerprint, digests)
+        self._last_published_step = int(step)
+        self._m_published.inc()
+        self._g_bytes.set(float(len(payload)))
+        self._g_delta.set(manifest["delta_fraction"])
+        self._g_seconds.set(dt)
+        if self._meter is not None:
+            self._meter.observe_batch(
+                run_s=dt,
+                traces=[
+                    SimpleNamespace(
+                        tenant="publish",
+                        tclass="batch",
+                        task="publish",
+                        bucket=1,
+                        tokens=None,
+                        pad_fraction=0.0,
+                    )
+                ],
+                batch=1,
+            )
+            self._meter.flush()
+        if self._emit is not None:
+            self._emit(
+                "publish",
+                step=int(step),
+                name=name,
+                fingerprint=fingerprint,
+                leaves=len(entries),
+                delta_leaves=len(in_payload),
+                delta_fraction=manifest["delta_fraction"],
+                bytes=len(payload),
+                seconds=round(dt, 3),
+                quant=self.quant,
+                base=base["name"] if base else None,
+            )
+        print(
+            f"[publish] {name} @ step {step}: {len(in_payload)}/{len(entries)} "
+            f"leaves, {len(payload)} bytes, {self.quant}, "
+            f"{'delta vs ' + base['name'] if base else 'full tree'}"
+        )
+        return final

@@ -5,6 +5,12 @@ from jumbo_mae_tpu_tpu.train.checkpoint import (
     import_params_msgpack,
     load_pretrained_params,
 )
+from jumbo_mae_tpu_tpu.train.engine import (
+    CheckpointEvent,
+    LogWindow,
+    RunEngine,
+    StepEvent,
+)
 from jumbo_mae_tpu_tpu.train.optim import OptimConfig, make_optimizer, make_schedule
 from jumbo_mae_tpu_tpu.train.state import TrainState
 from jumbo_mae_tpu_tpu.train.steps import (
@@ -19,6 +25,10 @@ __all__ = [
     "export_params_msgpack",
     "import_params_msgpack",
     "load_pretrained_params",
+    "CheckpointEvent",
+    "LogWindow",
+    "RunEngine",
+    "StepEvent",
     "OptimConfig",
     "make_optimizer",
     "make_schedule",

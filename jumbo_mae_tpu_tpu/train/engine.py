@@ -1,0 +1,292 @@
+"""RunEngine: the extracted step driver behind ``cli/train.py``.
+
+The training loop used to be a ~400-line ``while`` block owning every
+concern at once — data wait, dispatch, metric buffering, sentinel
+verdicts, journaling, beacons, memory sampling, rollback, checkpointing,
+preemption. The engine keeps only the *driver* logic:
+
+- the step counter and the ``while step < training_steps`` loop,
+- log-boundary batching of device metrics (sync ONLY at log boundaries —
+  a per-step ``device_get`` would serialize host dispatch against device
+  compute),
+- eval/checkpoint boundary arithmetic,
+- rollback control flow (a hook requests it; the registered rollback
+  hooks perform the restore and return the resumed step),
+- the preemption agreement point and the crash/shutdown ladder.
+
+Everything else registers as a component through lifecycle hooks, in the
+order the hooks should run:
+
+- ``pre_step(engine, step)`` — before the data wait (beacon writes).
+- ``on_step(engine, StepEvent)`` — after dispatch, metrics still on
+  device. Hooks may mutate ``ev.metrics`` (e.g. strip non-scalar legs
+  out of the pending buffer).
+- ``on_log_window(engine, LogWindow)`` — at log boundaries with the
+  window's fetched host metrics. Hooks share scratch via attributes on
+  the window (``bad_steps`` etc.) and may call
+  :meth:`RunEngine.request_rollback`.
+- ``on_rollback(engine, step, window) -> int | None`` — perform the
+  restore; the last non-``None`` return becomes the resumed step.
+- ``on_eval(engine, step, state) -> dict | None`` — eval-boundary work;
+  returned dicts merge into the checkpoint event's metrics.
+- ``on_checkpoint(engine, CheckpointEvent)`` — the save itself plus
+  anything riding the save (the weights publisher registers here).
+- ``on_crash(engine, exc)`` — the run is dying; dump black boxes. Hooks
+  may override ``engine.exit_reason``.
+- ``on_shutdown(engine, reason, step)`` — the ``finally`` ladder, run in
+  registration order however the loop exits.
+
+``train``/``eval``/``publish`` loops share this one tested core; the
+equivalence contract (same journal event stream as the monolithic loop)
+is pinned by ``tests/test_engine.py``'s golden and the chaos suite.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class StepEvent:
+    """One dispatched step; ``metrics`` may still live on device and is
+    mutable so hooks can strip non-scalar legs before buffering."""
+
+    __slots__ = ("step", "metrics")
+
+    def __init__(self, step: int, metrics):
+        self.step = step
+        self.metrics = metrics
+
+
+class LogWindow:
+    """One log boundary: ``fetched`` is ``[(step, host_metrics), ...]``
+    for every step dispatched since the previous boundary. Hooks share
+    derived scratch (``bad_steps``, ``summary``, ...) as attributes."""
+
+    def __init__(self, step: int, fetched: list):
+        self.step = step
+        self.fetched = fetched
+        self.bad_steps: list[int] = []
+
+
+class CheckpointEvent:
+    """One checkpoint boundary. ``reason`` is ``"interval"`` (periodic /
+    final-step save), ``"preemption"`` (stop-flag save on the way out).
+    ``metrics`` holds the merged ``on_eval`` results (``None`` when no
+    eval ran). Hooks may attach attributes for later hooks in the chain
+    (the saver stamps ``save_seconds``; the publisher reads it)."""
+
+    def __init__(self, step: int, metrics: dict | None, reason: str):
+        self.step = step
+        self.metrics = metrics
+        self.reason = reason
+
+
+class RunEngine:
+    """Hook-driven step driver (see module docstring).
+
+    ``next_batch(step)`` produces the step's batch (host wait accounting
+    belongs to the caller's closure); ``dispatch(state, batch, step) ->
+    (state, metrics)`` issues the device step. ``should_stop()`` is the
+    preemption agreement probe, evaluated at stop-safe boundaries only
+    (multi-host agreement needs an allgather — per-step would serialize
+    dispatch). ``fetch`` maps a list of device metric trees to host
+    (default ``jax.device_get``); injectable so the driver itself is
+    testable without a device.
+    """
+
+    def __init__(
+        self,
+        *,
+        training_steps: int,
+        start_step: int = 0,
+        log_interval: int = 1,
+        eval_interval: int = 0,
+        process_count: int = 1,
+        next_batch: Callable[[int], object],
+        dispatch: Callable,
+        should_stop: Callable[[], bool] | None = None,
+        fetch: Callable | None = None,
+    ):
+        self.training_steps = int(training_steps)
+        self.start_step = int(start_step)
+        self.log_interval = max(1, int(log_interval))
+        self.eval_interval = int(eval_interval)
+        self.process_count = int(process_count)
+        self._next_batch = next_batch
+        self._dispatch = dispatch
+        self._should_stop = should_stop
+        if fetch is None:
+            import jax
+
+            fetch = jax.device_get
+        self._fetch = fetch
+
+        self.state = None
+        self.step = self.start_step
+        self.exit_reason = "completed"
+        self._pending: list = []  # [(step, device metrics)] → log boundary
+        self._rollback_wanted = False
+        self._stop_reason: str | None = None
+        self._pre_step: list = []
+        self._on_step: list = []
+        self._on_log_window: list = []
+        self._on_rollback: list = []
+        self._on_eval: list = []
+        self._on_checkpoint: list = []
+        self._on_crash: list = []
+        self._on_shutdown: list = []
+
+    # -- hook registration (usable as decorators; registration order is
+    # -- execution order) ------------------------------------------------
+    def pre_step(self, fn):
+        self._pre_step.append(fn)
+        return fn
+
+    def on_step(self, fn):
+        self._on_step.append(fn)
+        return fn
+
+    def on_log_window(self, fn):
+        self._on_log_window.append(fn)
+        return fn
+
+    def on_rollback(self, fn):
+        self._on_rollback.append(fn)
+        return fn
+
+    def on_eval(self, fn):
+        self._on_eval.append(fn)
+        return fn
+
+    def on_checkpoint(self, fn):
+        self._on_checkpoint.append(fn)
+        return fn
+
+    def on_crash(self, fn):
+        self._on_crash.append(fn)
+        return fn
+
+    def on_shutdown(self, fn):
+        self._on_shutdown.append(fn)
+        return fn
+
+    # -- control requests (called from hooks) ----------------------------
+    def request_rollback(self) -> None:
+        """Ask the driver to run the rollback chain after the current log
+        window's hooks finish (the window must complete first: its
+        metrics/black-box records describe the divergence)."""
+        self._rollback_wanted = True
+
+    def request_stop(self, reason: str = "stopped") -> None:
+        """Ask the driver to exit at the next stop-safe boundary with
+        ``exit_reason=reason`` (checkpointing first, like preemption)."""
+        self._stop_reason = reason
+
+    # -- boundaries ------------------------------------------------------
+    def at_log_boundary(self, step: int) -> bool:
+        return step % self.log_interval == 0 or step == self.training_steps
+
+    def at_eval_boundary(self, step: int) -> bool:
+        return step == self.training_steps or (
+            self.eval_interval > 0 and step % self.eval_interval == 0
+        )
+
+    # -- the driver ------------------------------------------------------
+    def run(self, state):
+        """Drive ``state`` from ``start_step`` to ``training_steps``.
+        Returns the final state; ``exit_reason`` records how the loop
+        ended (``completed`` / ``preempted`` / hook-assigned)."""
+        self.state = state
+        step = self.start_step
+        self.step = step
+        try:
+            while step < self.training_steps:
+                step += 1
+                self.step = step
+                for fn in self._pre_step:
+                    fn(self, step)
+                batch = self._next_batch(step)
+                self.state, metrics = self._dispatch(self.state, batch, step)
+                ev = StepEvent(step, metrics)
+                for fn in self._on_step:
+                    fn(self, ev)
+                self._pending.append((ev.step, ev.metrics))
+
+                if self.at_log_boundary(step):
+                    # sync ONLY at log boundaries — one fetch for the
+                    # whole window's device scalars
+                    fetched = list(
+                        zip(
+                            [s for s, _ in self._pending],
+                            self._fetch([m for _, m in self._pending]),
+                        )
+                    )
+                    self._pending.clear()
+                    win = LogWindow(step, fetched)
+                    for fn in self._on_log_window:
+                        fn(self, win)
+                    if self._rollback_wanted:
+                        self._rollback_wanted = False
+                        new_step = None
+                        for fn in self._on_rollback:
+                            r = fn(self, step, win)
+                            if r is not None:
+                                new_step = r
+                        if new_step is None:
+                            raise RuntimeError(
+                                "rollback requested but no on_rollback hook "
+                                "returned the resumed step"
+                            )
+                        step = int(new_step)
+                        self.step = step
+                        continue
+
+                saved_this_step = False
+                if self.at_eval_boundary(step):
+                    evals: dict | None = None
+                    for fn in self._on_eval:
+                        r = fn(self, step, self.state)
+                        if r:
+                            evals = {**(evals or {}), **r}
+                    cev = CheckpointEvent(step, evals, reason="interval")
+                    for fn in self._on_checkpoint:
+                        fn(self, cev)
+                    saved_this_step = True
+
+                # Stop-safe boundary: single-host checks the flag every
+                # step; multi-host only at log/eval boundaries (agreement
+                # needs a host allgather), well inside any grace window.
+                boundary = (
+                    self.process_count == 1
+                    or saved_this_step
+                    or step % self.log_interval == 0
+                )
+                if boundary and (
+                    self._stop_reason is not None
+                    or (self._should_stop is not None and self._should_stop())
+                ):
+                    if not saved_this_step:
+                        cev = CheckpointEvent(step, None, reason="preemption")
+                        for fn in self._on_checkpoint:
+                            fn(self, cev)
+                    print(
+                        f"[train] preemption checkpoint at step {step}; "
+                        "exiting"
+                    )
+                    self.exit_reason = self._stop_reason or "preempted"
+                    break
+        except BaseException as e:
+            # default classification; on_crash hooks may refine it (the
+            # train CLI maps DivergenceError → "diverged" and dumps the
+            # flight recorder exactly here, while the ring still exists)
+            self.exit_reason = f"exception:{type(e).__name__}"
+            for fn in self._on_crash:
+                try:
+                    fn(self, e)
+                except Exception:  # noqa: BLE001 - never mask the real failure
+                    pass
+            raise
+        finally:
+            for fn in self._on_shutdown:
+                fn(self, self.exit_reason, self.step)
+        return self.state

@@ -1,0 +1,318 @@
+"""RunEngine contracts (train/engine.py) + the extraction-equivalence golden.
+
+The engine owns only driver logic — step counting, log-boundary metric
+batching, eval/checkpoint arithmetic, rollback control flow, the stop-safe
+preemption boundary, and the crash/shutdown ladder. Everything else is a
+registered hook. Two layers of coverage:
+
+- **Deviceless unit tests**: the driver runs with ``fetch=identity`` and
+  pure-python dispatch, so hook ordering, boundary arithmetic, rollback
+  resume, stop requests, and the crash ladder are pinned without JAX ever
+  dispatching a step.
+- **The extraction golden** (slow): a seeded 12-step ``cli/train.py`` run
+  must emit the exact journal event sequence (types + steps) the
+  pre-refactor monolithic loop emitted, with the identical final loss —
+  the equivalence contract of the ISSUE-18 refactor. Rollback / SIGTERM /
+  flightrec behavior is additionally pinned by ``tests/test_chaos.py``
+  passing unmodified.
+"""
+
+import json
+
+import pytest
+
+from jumbo_mae_tpu_tpu.train.engine import RunEngine
+
+
+def make_engine(
+    *,
+    steps=12,
+    log_interval=2,
+    eval_interval=4,
+    should_stop=None,
+    dispatch=None,
+    process_count=1,
+):
+    def _dispatch(state, batch, step):
+        return state + 1, {"loss": float(step)}
+
+    return RunEngine(
+        training_steps=steps,
+        log_interval=log_interval,
+        eval_interval=eval_interval,
+        process_count=process_count,
+        next_batch=lambda step: step,
+        dispatch=dispatch or _dispatch,
+        should_stop=should_stop,
+        fetch=lambda ms: ms,  # deviceless: metrics are already host values
+    )
+
+
+def test_hook_order_and_boundaries():
+    eng = make_engine()
+    trace = []
+    eng.pre_step(lambda e, s: trace.append(("pre", s)))
+    eng.on_step(lambda e, ev: trace.append(("step", ev.step)))
+    eng.on_log_window(
+        lambda e, win: trace.append(("log", win.step, [s for s, _ in win.fetched]))
+    )
+    eng.on_eval(lambda e, s, st: trace.append(("eval", s)) or {"val/x": 1.0})
+    eng.on_checkpoint(lambda e, cev: trace.append(("ckpt", cev.step, cev.reason)))
+    eng.on_shutdown(lambda e, reason, s: trace.append(("shutdown", reason, s)))
+
+    out = eng.run(0)
+    assert out == 12  # dispatch incremented state once per step
+    assert eng.exit_reason == "completed"
+    # log windows batch exactly the steps since the previous boundary
+    assert [t for t in trace if t[0] == "log"] == [
+        ("log", 2, [1, 2]),
+        ("log", 4, [3, 4]),
+        ("log", 6, [5, 6]),
+        ("log", 8, [7, 8]),
+        ("log", 10, [9, 10]),
+        ("log", 12, [11, 12]),
+    ]
+    assert [t for t in trace if t[0] == "eval"] == [
+        ("eval", 4), ("eval", 8), ("eval", 12)
+    ]
+    assert [t for t in trace if t[0] == "ckpt"] == [
+        ("ckpt", 4, "interval"), ("ckpt", 8, "interval"), ("ckpt", 12, "interval")
+    ]
+    assert trace[-1] == ("shutdown", "completed", 12)
+    # within one step: pre before step; the eval at a boundary precedes
+    # its checkpoint
+    i_pre = trace.index(("pre", 4))
+    i_step = trace.index(("step", 4))
+    i_eval = trace.index(("eval", 4))
+    i_ckpt = trace.index(("ckpt", 4, "interval"))
+    assert i_pre < i_step < i_eval < i_ckpt
+
+
+def test_eval_results_merge_into_checkpoint_event():
+    eng = make_engine(steps=4, eval_interval=4)
+    eng.on_eval(lambda e, s, st: {"val/a": 1.0})
+    eng.on_eval(lambda e, s, st: {"val/b": 2.0})
+    eng.on_eval(lambda e, s, st: None)  # a hook with nothing to add
+    got = {}
+    eng.on_checkpoint(lambda e, cev: got.update(cev.metrics))
+    eng.run(0)
+    assert got == {"val/a": 1.0, "val/b": 2.0}
+
+
+def test_final_step_is_always_a_boundary():
+    eng = make_engine(steps=7, log_interval=3, eval_interval=5)
+    logs, ckpts = [], []
+    eng.on_log_window(lambda e, win: logs.append(win.step))
+    eng.on_checkpoint(lambda e, cev: ckpts.append(cev.step))
+    eng.run(0)
+    assert logs == [3, 6, 7]  # step 7 != 0 mod 3, but it's the last step
+    assert ckpts == [5, 7]
+
+
+def test_eval_interval_zero_checkpoints_only_at_the_end():
+    eng = make_engine(steps=6, eval_interval=0)
+    ckpts = []
+    eng.on_checkpoint(lambda e, cev: ckpts.append(cev.step))
+    eng.run(0)
+    assert ckpts == [6]
+
+
+def test_rollback_resumes_from_hook_returned_step():
+    eng = make_engine(steps=8, log_interval=2, eval_interval=4)
+    windows, rollbacks = [], []
+
+    def window(e, win):
+        windows.append(win.step)
+        if win.step == 6 and not rollbacks:
+            e.request_rollback()
+
+    def rollback(e, step, win):
+        rollbacks.append(step)
+        e.state = 100  # the restore replaces the engine's state
+        return 4
+
+    eng.on_log_window(window)
+    eng.on_rollback(rollback)
+    out = eng.run(0)
+    assert rollbacks == [6]
+    # resumed from 4: steps 5..8 run again, so windows 6 and 8 repeat
+    assert windows == [2, 4, 6, 6, 8]
+    assert out == 100 + 4  # restored state + the 4 re-dispatched steps
+
+
+def test_rollback_without_resume_step_raises():
+    eng = make_engine(steps=2, log_interval=1)
+    eng.on_log_window(lambda e, win: e.request_rollback())
+    eng.on_rollback(lambda e, step, win: None)
+    with pytest.raises(RuntimeError, match="no on_rollback hook"):
+        eng.run(0)
+
+
+def test_request_stop_checkpoints_then_exits(capsys):
+    eng = make_engine(steps=100, log_interval=2, eval_interval=0)
+    ckpts = []
+    eng.on_log_window(
+        lambda e, win: e.request_stop("drained") if win.step == 4 else None
+    )
+    eng.on_checkpoint(lambda e, cev: ckpts.append((cev.step, cev.reason)))
+    eng.run(0)
+    assert eng.exit_reason == "drained"
+    assert ckpts == [(4, "preemption")]
+    assert "preemption checkpoint at step 4" in capsys.readouterr().out
+
+
+def test_should_stop_multi_host_waits_for_a_boundary():
+    # multi-host: the stop flag set mid-window must not fire until the
+    # next log boundary (agreement needs an allgather)
+    stops = iter([False, True])
+    eng = make_engine(
+        steps=100,
+        log_interval=3,
+        eval_interval=0,
+        process_count=2,
+        should_stop=lambda: next(stops),
+    )
+    ckpts = []
+    eng.on_checkpoint(lambda e, cev: ckpts.append(cev.step))
+    eng.run(0)
+    # should_stop consulted only at boundaries: step 3 (False), step 6 (True)
+    assert eng.step == 6 and ckpts == [6]
+    assert eng.exit_reason == "preempted"
+
+
+def test_no_duplicate_checkpoint_when_stop_lands_on_eval_boundary():
+    eng = make_engine(steps=100, log_interval=2, eval_interval=4)
+    ckpts = []
+    eng.on_log_window(
+        lambda e, win: e.request_stop() if win.step == 4 else None
+    )
+    eng.on_checkpoint(lambda e, cev: ckpts.append((cev.step, cev.reason)))
+    eng.run(0)
+    assert ckpts == [(4, "interval")]  # saved_this_step suppresses the second
+
+
+def test_crash_ladder_runs_crash_then_shutdown_hooks():
+    def dispatch(state, batch, step):
+        if step == 3:
+            raise ValueError("boom")
+        return state, {"loss": 0.0}
+
+    eng = make_engine(steps=10, dispatch=dispatch)
+    order = []
+    eng.on_crash(lambda e, exc: order.append(("crash", type(exc).__name__)))
+    eng.on_crash(lambda e, exc: (_ for _ in ()).throw(RuntimeError("hook")))
+    eng.on_crash(lambda e, exc: order.append(("crash2", e.exit_reason)))
+    eng.on_shutdown(lambda e, reason, s: order.append(("shutdown", reason, s)))
+    with pytest.raises(ValueError, match="boom"):
+        eng.run(0)
+    # a throwing crash hook never masks the real failure or later hooks
+    assert order == [
+        ("crash", "ValueError"),
+        ("crash2", "exception:ValueError"),
+        ("shutdown", "exception:ValueError", 3),
+    ]
+
+
+def test_crash_hook_can_reclassify_exit_reason():
+    def dispatch(state, batch, step):
+        raise ValueError("diverged-ish")
+
+    eng = make_engine(steps=2, dispatch=dispatch)
+    reasons = []
+    eng.on_crash(lambda e, exc: setattr(e, "exit_reason", "diverged"))
+    eng.on_shutdown(lambda e, reason, s: reasons.append(reason))
+    with pytest.raises(ValueError):
+        eng.run(0)
+    assert reasons == ["diverged"]
+
+
+def test_step_event_metrics_are_mutable_before_buffering():
+    eng = make_engine(steps=2, log_interval=2)
+
+    def strip(e, ev):
+        m = dict(ev.metrics)
+        m.pop("loss")
+        ev.metrics = m
+
+    seen = []
+    eng.on_step(strip)
+    eng.on_log_window(lambda e, win: seen.extend(m for _, m in win.fetched))
+    eng.run(0)
+    assert seen == [{}, {}]
+
+
+def test_start_step_resume_boundaries():
+    eng = RunEngine(
+        training_steps=6,
+        start_step=4,
+        log_interval=2,
+        eval_interval=0,
+        next_batch=lambda s: s,
+        dispatch=lambda st, b, s: (st, {}),
+        fetch=lambda ms: ms,
+    )
+    logs = []
+    eng.on_log_window(lambda e, win: logs.append([s for s, _ in win.fetched]))
+    eng.run(0)
+    assert logs == [[5, 6]]
+
+
+# ------------------------------------------------- extraction equivalence
+
+# Captured from the pre-refactor monolithic while-loop (commit 8f63783) on
+# the seeded config below: the journal event stream (type, step) and the
+# window-mean final loss/grad_norm. The engine-driven loop must reproduce
+# both exactly — same events, same order, same arithmetic.
+GOLDEN_SEQUENCE = [
+    ("run_start", None),
+    ("compiled_program", None),
+    ("step", 2),
+    ("mem_sample", 2),
+    ("step", 4),
+    ("mem_sample", 4),
+    ("checkpoint_save", 4),
+    ("step", 6),
+    ("mem_sample", 6),
+    ("step", 8),
+    ("mem_sample", 8),
+    ("checkpoint_save", 8),
+    ("step", 10),
+    ("mem_sample", 10),
+    ("step", 12),
+    ("mem_sample", 12),
+    ("checkpoint_save", 12),
+    ("shutdown", 12),
+]
+GOLDEN_FINAL = {"train/loss": 1.0147541761398315, "train/grad_norm": 0.3212621212005615}
+
+
+@pytest.mark.slow
+def test_extracted_loop_matches_pre_refactor_golden(tmp_path):
+    from jumbo_mae_tpu_tpu.cli.train import train
+    from jumbo_mae_tpu_tpu.config import load_config
+    from jumbo_mae_tpu_tpu.obs.journal import read_journal
+
+    cfg = load_config(
+        "recipes/smoke_cpu.yaml",
+        [
+            f"run.output_dir={tmp_path}",
+            "run.training_steps=12",
+            "optim.training_steps=12",
+            "run.sanity_eval=false",
+            "run.log_interval=2",
+            "run.eval_interval=4",
+            "run.use_wandb=false",
+            # the leak sentinel keys off machine-dependent RSS growth; its
+            # events would make the stream nondeterministic
+            "run.memwatch_leak_mb=100000",
+        ],
+    )
+    final = train(cfg)
+    events = read_journal(f"{tmp_path}/smoke_cpu/journal")
+    seq = [(e["type"], e.get("step")) for e in events]
+    assert seq == GOLDEN_SEQUENCE, (
+        "journal stream diverged from the pre-refactor golden:\n"
+        + json.dumps(seq)
+    )
+    for k, v in GOLDEN_FINAL.items():
+        assert final[k] == pytest.approx(v, rel=1e-6), (k, final[k])

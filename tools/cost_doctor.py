@@ -10,6 +10,11 @@ periodic ``tenant_usage`` events carry the meter's cumulative ledgers.
     python tools/cost_doctor.py runs/serve/access
     python tools/cost_doctor.py ... --out chargeback.md
 
+A training journal works too: the gated weights publisher bills each
+publish to a ``publish`` tenant as ``tenant_usage`` rows, which surface
+as a *ledger-only* tenant in the chargeback table (no request rows — the
+bill comes straight from the journaled ledger).
+
 The report, in order:
 
 - **Chargeback** — per-tenant cost table: requests, ok/shed, device-
@@ -100,6 +105,28 @@ def diagnose(rows: list[dict], events: list[dict]) -> tuple[str, str | None]:
     lines: list[str] = ["# Cost doctor report", ""]
     verdict: list[str] = []
     bills = _tenant_bills(rows)
+    # last tenant_usage row per tenant = the meter's final cumulative word
+    usage: dict[str, dict] = {}
+    for e in events:
+        if e.get("type") == "tenant_usage" and e.get("tenant"):
+            usage[str(e["tenant"])] = e
+    # ledger-only tenants never emit request rows — e.g. the train-side
+    # ``publish`` tenant, billed per weights-publish straight into the
+    # training journal — so their bill comes from the journaled ledger
+    ledger_only = sorted(set(usage) - set(bills))
+    for name in ledger_only:
+        u = usage[name]
+        bills[name] = {
+            "class": str(u.get("class") or "?"),
+            "requests": int(u.get("requests") or 0),
+            "ok": int(u.get("requests") or 0),
+            "shed": 0,
+            "shed_reasons": {},
+            "device_s": float(u.get("device_s") or 0.0),
+            "flops": float(u.get("flops") or 0.0),
+            "waste_s": float(u.get("waste_device_s") or 0.0),
+            "lat_ms": [],
+        }
     total_dev = sum(b["device_s"] for b in bills.values())
     total_flops = sum(b["flops"] for b in bills.values())
 
@@ -144,6 +171,11 @@ def diagnose(rows: list[dict], events: list[dict]) -> tuple[str, str | None]:
             f"({bills[top]['device_s'] / total_dev * 100:.1f}% of "
             f"device-time)"
         )
+    if ledger_only:
+        lines.append(
+            "- ledger-only tenant(s) (no request rows; billed from "
+            "`tenant_usage`): " + ", ".join(f"`{t}`" for t in ledger_only)
+        )
     lines.append("")
 
     # ---------------------------------------------------- waste attribution
@@ -165,11 +197,6 @@ def diagnose(rows: list[dict], events: list[dict]) -> tuple[str, str | None]:
         lines.append("")
 
     # -------------------------------------------------------------- budgets
-    # last tenant_usage row per tenant = the meter's final cumulative word
-    usage: dict[str, dict] = {}
-    for e in events:
-        if e.get("type") == "tenant_usage" and e.get("tenant"):
-            usage[str(e["tenant"])] = e
     budgeted = {
         t: u for t, u in usage.items() if u.get("budget_device_s") is not None
     }
@@ -271,9 +298,10 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     rows = [e for e in events if e.get("type") == "request"]
     costed = [r for r in rows if r.get("device_ms") is not None]
-    if not rows or (
-        not costed
-        and not any(e.get("type") == "tenant_usage" for e in events)
+    # a training journal has no request rows at all, but its tenant_usage
+    # ledger (the `publish` tenant) is still chargeable
+    if not costed and not any(
+        e.get("type") == "tenant_usage" for e in events
     ):
         print(
             f"[cost_doctor] no costed request rows or tenant_usage events "
