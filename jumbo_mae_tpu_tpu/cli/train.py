@@ -17,6 +17,7 @@ Run:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import math
 import os
 import time
@@ -34,7 +35,10 @@ from jumbo_mae_tpu_tpu.config import (
 )
 from jumbo_mae_tpu_tpu.data import (
     TrainLoader,
+    epoch_shard_order,
+    merge_shard_states,
     prefetch_to_device,
+    resize_assignment,
     split_for_accum,
     synthetic_batches,
     valid_loader,
@@ -59,9 +63,13 @@ from jumbo_mae_tpu_tpu.models import (
 )
 from jumbo_mae_tpu_tpu.parallel import batch_sharding, create_mesh
 from jumbo_mae_tpu_tpu.train import (
+    EXIT_FATAL,
+    EXIT_HANG,
+    EXIT_OK,
     Checkpointer,
     RunEngine,
     create_sharded_state,
+    exit_code_for,
     load_pretrained_params,
     make_eval_step,
     make_optimizer,
@@ -70,6 +78,7 @@ from jumbo_mae_tpu_tpu.train import (
 from jumbo_mae_tpu_tpu.obs import (
     FleetAggregator,
     FlightRecorder,
+    HangWatchdog,
     HealthState,
     HostBeacon,
     RunJournal,
@@ -167,6 +176,7 @@ def make_train_iterator(
     start_step: int = 0,
     data_cursor: dict | None = None,
     num_labels: int = 1000,
+    shard_override: list | None = None,
 ):
     """Build the device-prefetched train iterator.
 
@@ -179,9 +189,18 @@ def make_train_iterator(
     epoch yields dataset_size × repeats samples (repeated augmentation
     clones count toward the batch).
 
-    Returns ``(iterator, source, cursor_log)`` — ``cursor_log`` maps each
-    absolute step to the loader snapshot after that step's batch left the
-    loader (prefetch-safe: recorded at loader exit, consumed by step index).
+    ``shard_override`` is the resize-consistent resume path: explicit
+    ``(global_index, url)`` pairs for this process's share of the resume
+    epoch (computed by :func:`_resize_shard_override` from the journaled
+    shard cursors), replacing the topology-derived stripe for that epoch
+    only.
+
+    Returns ``(iterator, source, cursor_log, shard_log)`` — ``cursor_log``
+    maps each absolute step to the loader snapshot after that step's batch
+    left the loader (prefetch-safe: recorded at loader exit, consumed by
+    step index); ``shard_log`` likewise maps steps to the merged
+    consumed-shard ledger snapshot, journaled as ``shard_cursor`` at each
+    checkpoint so a future resized resume can reconstruct the assignment.
     """
     start_epoch = (start_step * cfg.run.train_batch_size) // max(
         1, cfg.data.dataset_size * max(1, cfg.data.repeats)
@@ -200,6 +219,7 @@ def make_train_iterator(
             )
         print(f"[train] data cursor: resuming stream at epoch {start_epoch}")
     cursor_log: dict[int, dict] = {}
+    shard_log: dict[int, dict] = {}
     if cfg.run.synthetic_data:
         it = synthetic_batches(
             per_process,
@@ -220,7 +240,11 @@ def make_train_iterator(
         )
         try:
             source = TrainLoader(
-                cfg.data, per_process, cursor=data_cursor, **loader_kwargs
+                cfg.data,
+                per_process,
+                cursor=data_cursor,
+                epoch_shard_override=shard_override,
+                **loader_kwargs,
             )
             if data_cursor is not None:
                 print(
@@ -231,20 +255,28 @@ def make_train_iterator(
             if data_cursor is None:
                 raise
             print(f"[train] WARNING: {e}; falling back to epoch-{start_epoch} resume")
-            source = TrainLoader(cfg.data, per_process, **loader_kwargs)
+            source = TrainLoader(
+                cfg.data,
+                per_process,
+                epoch_shard_override=shard_override,
+                **loader_kwargs,
+            )
 
         def tracked():
             step = start_step
             for b in source:
                 step += 1
                 cursor_log[step] = source.snapshot()
+                shards = source.shard_snapshot()
+                if shards is not None:
+                    shard_log[step] = shards
                 yield b
 
         it = (split_for_accum(b, cfg.run.grad_accum) for b in tracked())
     it = ({k: v for k, v in b.items() if k != "valid"} for b in it)
     it = (_strip_for_model(cfg, b) for b in it)
     sharding = batch_sharding(mesh, accum=cfg.run.grad_accum > 1)
-    return prefetch_to_device(it, sharding), source, cursor_log
+    return prefetch_to_device(it, sharding), source, cursor_log, shard_log
 
 
 def make_valid_iterator(
@@ -381,6 +413,64 @@ def _gather_data_cursor(snap: dict | None) -> dict | None:
     if snap.get("native_threads") is not None:
         out["native_threads"] = snap["native_threads"]
     return out
+
+
+def _resize_shard_override(
+    cfg: TrainConfig,
+    run_dir: Path,
+    start_step: int,
+    old_world: int,
+    *,
+    world: int,
+    host: int,
+) -> tuple[list, dict]:
+    """Resize-consistent resume (data/resize.py): reconstruct this process's
+    shard assignment for the resume epoch from the journaled cursors.
+
+    Reads the run's merged journal, takes each old host's ``shard_cursor``
+    at the restored step, unions the consumed sets, and stripes the
+    epoch's remainder across the NEW world — a pure function of
+    ``(world, host, journal)``, no collective, so every process computes a
+    disjoint, exhaustive assignment independently. Raises when no cursor
+    exists for the step (pre-elastic checkpoint, journal disabled) — the
+    caller falls back to plain epoch resume.
+    """
+    from jumbo_mae_tpu_tpu.obs.journal import read_merged_journal
+
+    latest: dict[int, dict] = {}
+    for e in read_merged_journal(run_dir):
+        if (
+            e.get("type") == "shard_cursor"
+            and int(e.get("step", -1)) == start_step
+        ):
+            latest[int(e.get("host", 0))] = e
+    if not latest:
+        raise FileNotFoundError(
+            f"no shard_cursor journal events at step {start_step} "
+            f"under {run_dir}"
+        )
+    merged = merge_shard_states(
+        [{"epochs": e.get("epochs") or {}} for e in latest.values()]
+    )
+    start_epoch = (start_step * cfg.run.train_batch_size) // max(
+        1, cfg.data.dataset_size * max(1, cfg.data.repeats)
+    )
+    order = epoch_shard_order(
+        cfg.data.train_shards, seed=cfg.run.seed, epoch=start_epoch
+    )
+    consumed = merged.get(start_epoch, set())
+    pairs = resize_assignment(order, consumed, world_size=world, process_id=host)
+    info = {
+        "step": start_step,
+        "epoch": start_epoch,
+        "old_world": old_world,
+        "new_world": world,
+        "shards_total": len(order),
+        "shards_consumed": len(consumed),
+        "shards_remaining": len(order) - len(consumed),
+        "cursor_hosts": sorted(latest),
+    }
+    return pairs, info
 
 
 def evaluate(eval_step, state, batches, pad_batch: dict | None = None) -> dict[str, float]:
@@ -596,6 +686,7 @@ def train(cfg: TrainConfig) -> dict:
 
     start_step = 0
     data_cursor = None
+    ckpt_fallbacks: list[dict] = []  # journaled once the journal exists
     if resuming:
         if run.eval_only:
             # params/batch_stats/rng only — the saved opt_state never
@@ -604,7 +695,25 @@ def train(cfg: TrainConfig) -> dict:
                 state, sharding=state_sharding, which=eval_which
             )
         else:
-            state, extra = ckpt.restore(state, sharding=state_sharding)
+            # a corrupt/torn latest step (host died mid-commit, fs
+            # hiccup) walks back to the previous committed step instead
+            # of killing the resume — bounded, and journaled below so
+            # the replayed window is auditable
+            def _note_fallback(from_step, to_step, err):
+                ckpt_fallbacks.append(
+                    {
+                        "from_step": int(from_step),
+                        "to_step": int(to_step),
+                        "error": f"{type(err).__name__}: {err}",
+                    }
+                )
+
+            state, extra = ckpt.restore(
+                state,
+                sharding=state_sharding,
+                fallback_steps=2,
+                on_fallback=_note_fallback,
+            )
         start_step = int(state.step)
         data_cursor = extra.get("data_cursor")
         print(f"[train] resumed from step {start_step}")
@@ -773,6 +882,8 @@ def train(cfg: TrainConfig) -> dict:
         diag_every=run.diag_every,
         diag_groups=list(diag_names),
     )
+    for fb in ckpt_fallbacks:
+        _emit("ckpt_fallback", **fb)
 
     # fleet health (obs/fleet.py): every host rewrites its beacon each step;
     # host 0 additionally aggregates the beacon dir into fleet_* gauges (on
@@ -805,9 +916,90 @@ def train(cfg: TrainConfig) -> dict:
         except OSError:  # a shared-fs hiccup must not kill the run
             pass
 
-    train_iter, source, cursor_log = make_train_iterator(
+    # hang watchdog (obs/hangwatch.py): beats ride the pre-step hook; a
+    # wedged collective stops them, and at run.hangwatch_deadline_s the
+    # watchdog journals the stall, drains the async checkpoint writer
+    # (bounded), and exits EXIT_HANG — the elastic supervisor converts
+    # that into a restart instead of an indefinite stall
+    hangwatch = None
+    if run.hangwatch_deadline_s > 0:
+        hangwatch = HangWatchdog(
+            run.hangwatch_deadline_s,
+            exit_code=EXIT_HANG,
+            drain=ckpt.wait,
+        )
+
+        @hangwatch.on_fire
+        def _hang_fired(info):
+            _emit("hang_detected", host=host_index, **info)
+            _beacon_write(int(info.get("step") or 0))
+            if flightrec is not None:
+                try:
+                    flightrec.dump("hang_detected", extra=info)
+                except Exception:  # noqa: BLE001 - already dying loudly
+                    pass
+            print(
+                f"[train] HANG: no step progress for "
+                f"{info['stalled_s']:.0f}s (deadline "
+                f"{info['deadline_s']:.0f}s) — exiting {EXIT_HANG}"
+            )
+
+        hangwatch.start()
+        print(
+            f"[train] hang watchdog armed after step 1: deadline "
+            f"{run.hangwatch_deadline_s:.0f}s -> exit {EXIT_HANG}"
+        )
+
+    def _hw_expected(reason: str):
+        """Legitimately-slow phases (eval, rollback restore, checkpoint
+        waits) suspend the step-deadline clock; the fleet.wedge fault and
+        real collective stalls sit OUTSIDE every such window."""
+        return (
+            hangwatch.expected(reason)
+            if hangwatch is not None
+            else contextlib.nullcontext()
+        )
+
+    # resize-consistent resume: a checkpoint saved under a different
+    # world size voids the sample-exact cursor, but the journaled shard
+    # cursors reconstruct a shard-exact assignment for the new topology
+    # (no shard double-counted, none skipped — tests/test_elastic.py)
+    shard_override = None
+    if (
+        data_cursor is not None
+        and int(data_cursor.get("process_count", 1)) != process_count
+        and not run.synthetic_data
+        and cfg.data.train_shards
+    ):
+        old_world = int(data_cursor.get("process_count", 1))
+        try:
+            shard_override, rinfo = _resize_shard_override(
+                cfg,
+                run_dir,
+                start_step,
+                old_world,
+                world=process_count,
+                host=host_index,
+            )
+        except Exception as e:  # noqa: BLE001 - epoch resume still works
+            print(
+                f"[train] WARNING: resize-consistent resume unavailable "
+                f"({e}); falling back to epoch resume"
+            )
+        else:
+            data_cursor = None  # topology changed: the sample cursor is void
+            _emit("elastic_resize", **rinfo)
+            print(
+                f"[train] elastic resize: world {old_world} -> "
+                f"{process_count}; epoch {rinfo['epoch']} resumes with "
+                f"{rinfo['shards_remaining']}/{rinfo['shards_total']} "
+                "shards unconsumed"
+            )
+
+    train_iter, source, cursor_log, shard_log = make_train_iterator(
         cfg, mesh, per_process, start_step, data_cursor,
         num_labels=enc_cfg.labels or 1000,
+        shard_override=shard_override,
     )
     meter = AverageMeter()
     timer = StepTimer(warmup_steps=min(2, max(1, run.training_steps - 1)))
@@ -924,6 +1116,10 @@ def train(cfg: TrainConfig) -> dict:
             # the module ballast (the leak sentinel's test fixture);
             # a raise action models "the leak got fixed" and clears
             host_leak_tick(key=str(step_now))
+            # fleet.wedge chaos site: delay(s) past the hangwatch
+            # deadline holds THIS host's step outside any expected()
+            # window — the watchdog, not the data path, must catch it
+            fault_point("fleet.wedge", key=str(step_now), data=None)
             lm = fault_point("train.loss", key=str(step_now), data=1.0)
             gm = fault_point("train.grad", key=str(step_now), data=1.0)
             if (lm, gm) != (1.0, 1.0):
@@ -962,14 +1158,20 @@ def train(cfg: TrainConfig) -> dict:
         nonlocal window_steps
         _beacon_write(step_now)
         window_steps += 1
+        if hangwatch is not None:
+            hangwatch.beat(step_now)
 
     @engine.on_step
     def _telemetry_component(eng, ev):
         c_steps.inc()
         g_step.set(ev.step)
         health.beat("train_step")
-        if retrace_sentinel is not None and ev.step == start_step + 1:
-            retrace_sentinel.arm()  # warmup over: steady state begins
+        if ev.step == start_step + 1:
+            # warmup over (first step compiled + dispatched): steady state
+            if retrace_sentinel is not None:
+                retrace_sentinel.arm()
+            if hangwatch is not None:
+                hangwatch.arm()
 
     @engine.on_step
     def _diag_component(eng, ev):
@@ -992,6 +1194,8 @@ def train(cfg: TrainConfig) -> dict:
         # time, or sparse checkpointing grows host memory without bound
         for k in [k for k in cursor_log if k < ev.step]:
             del cursor_log[k]
+        for k in [k for k in shard_log if k < ev.step]:
+            del shard_log[k]
 
     @engine.on_log_window
     def _log_window(eng, win):
@@ -1141,10 +1345,19 @@ def train(cfg: TrainConfig) -> dict:
             )
             _beacon_write(step)
             if fleet_agg is not None:
+                fsum = None
                 try:
-                    fleet_agg.scan()
+                    fsum = fleet_agg.scan()
                 except OSError:
                     pass
+                if fsum and fsum.get("lost"):
+                    # a peer's beacon went stale past dead_after_s: the
+                    # next collective would block on it forever — exit
+                    # EXIT_ELASTIC at the stop-safe boundary and let the
+                    # supervisor relaunch at the surviving world size
+                    eng.notify_host_lost(
+                        {"hosts": fsum["lost"], "detected_by": "beacon"}
+                    )
         window_t0, window_wait, window_steps = now, 0.0, 0
         logger.log(summary, step=step)
         last_metrics = summary
@@ -1212,7 +1425,7 @@ def train(cfg: TrainConfig) -> dict:
         # (params + optimizer + RNG + data cursor) and continue
         # from there. Skipping alone can't fix a state that is
         # already bad — rewinding to a known-good one can.
-        nonlocal train_iter, source, cursor_log, prev_window_bad
+        nonlocal train_iter, source, cursor_log, shard_log, prev_window_bad
         if ckpt.latest_step("last") is None:
             raise DivergenceError(
                 f"training diverged at step {step} with no "
@@ -1220,8 +1433,11 @@ def train(cfg: TrainConfig) -> dict:
                 "set run.eval_interval below the failure point"
             )
         sentinel.record_rollback()  # raises once budget is spent
-        ckpt.wait()  # a save may still be in flight
-        eng.state, extra = ckpt.restore(eng.state, sharding=state_sharding)
+        with _hw_expected("rollback"):
+            ckpt.wait()  # a save may still be in flight
+            eng.state, extra = ckpt.restore(
+                eng.state, sharding=state_sharding
+            )
         rolled_from, new_step = step, int(eng.state.step)
         print(
             f"[train] sentinel rollback #{sentinel.rollbacks} → "
@@ -1245,11 +1461,12 @@ def train(cfg: TrainConfig) -> dict:
         prev_window_bad = False  # restored stream starts clean
         if source is not None:
             source.close()
-        train_iter, source, cursor_log = make_train_iterator(
-            cfg, mesh, per_process, new_step,
-            extra.get("data_cursor"),
-            num_labels=enc_cfg.labels or 1000,
-        )
+        with _hw_expected("rollback-restart"):
+            train_iter, source, cursor_log, shard_log = make_train_iterator(
+                cfg, mesh, per_process, new_step,
+                extra.get("data_cursor"),
+                num_labels=enc_cfg.labels or 1000,
+            )
         return new_step
 
     @engine.on_eval
@@ -1257,33 +1474,46 @@ def train(cfg: TrainConfig) -> dict:
         nonlocal last_metrics
         if valid_factory is None:
             return None
-        if retrace_sentinel is not None:
-            with retrace_sentinel.expected("eval"):
+        with _hw_expected("eval"):
+            if retrace_sentinel is not None:
+                with retrace_sentinel.expected("eval"):
+                    val = evaluate(
+                        eval_step, state_now, valid_factory(), pad_batch
+                    )
+            else:
                 val = evaluate(eval_step, state_now, valid_factory(), pad_batch)
-        else:
-            val = evaluate(eval_step, state_now, valid_factory(), pad_batch)
         logger.log(val, step=step)
         last_metrics |= val
         return val
+
+    def _emit_shard_cursor(step: int) -> None:
+        # every host journals its consumed-shard ledger AT the
+        # checkpointed step — the crash-safe, per-host cursor a future
+        # resized resume merges (data/resize.py); no collective, so a
+        # SIGKILL'd peer can't strand it
+        shards = shard_log.get(step)
+        if shards is not None:
+            _emit("shard_cursor", step=step, world=process_count, **shards)
 
     @engine.on_checkpoint
     def _checkpoint_component(eng, cev):
         step = cev.step
         if cev.reason == "preemption":
             snap = _gather_data_cursor(cursor_log.get(step))
-            with sp_ckpt:
+            with _hw_expected("checkpoint"), sp_ckpt:
                 ckpt.save(
                     step,
                     eng.state,
                     extra={"data_cursor": snap} if snap is not None else None,
                 )
             _emit("checkpoint_save", step=step, preemption=True)
+            _emit_shard_cursor(step)
             return
         snap = _gather_data_cursor(cursor_log.get(step))
         extra = {"data_cursor": snap} if snap is not None else None
         for k in [k for k in cursor_log if k <= step]:
             del cursor_log[k]
-        with sp_ckpt:
+        with _hw_expected("checkpoint"), sp_ckpt:
             ckpt.save(step, eng.state, metrics=cev.metrics, extra=extra)
         cev.save_seconds = round(sp_ckpt.last_s, 3)
         _emit(
@@ -1292,6 +1522,14 @@ def train(cfg: TrainConfig) -> dict:
             eval_metrics=cev.metrics,
             save_seconds=cev.save_seconds,
         )
+        _emit_shard_cursor(step)
+        for k in [k for k in shard_log if k <= step]:
+            del shard_log[k]
+
+    @engine.on_host_lost
+    def _host_lost_component(eng, info):
+        _emit("host_lost", step=eng.step, **info)
+        _black_box("host_lost", step=eng.step, **info)
 
     @engine.on_crash
     def _crash_component(eng, exc):
@@ -1309,6 +1547,21 @@ def train(cfg: TrainConfig) -> dict:
                 )
             except Exception:  # noqa: BLE001 - never mask the real failure
                 pass
+
+    @engine.on_shutdown
+    def _drain_shutdown(eng, reason, step):
+        # the watchdog stands down FIRST: a long final wait_until_finished
+        # is a clean drain, not a hang. The drain itself runs on every
+        # supervisor-visible exit path (SIGTERM preemption, host_lost,
+        # crash) — an async Orbax save left in flight at process exit is
+        # a torn step the next resume would have to walk back from.
+        if hangwatch is not None:
+            hangwatch.disarm()
+            hangwatch.stop()
+        try:
+            ckpt.wait()
+        except Exception as e:  # noqa: BLE001 - never mask the real failure
+            print(f"[train] WARNING: checkpoint drain on shutdown failed: {e}")
 
     @engine.on_shutdown
     def _retrace_shutdown(eng, reason, step):
@@ -1366,7 +1619,9 @@ def train(cfg: TrainConfig) -> dict:
         telemetry.close()
     if source is not None:
         source.close()
-    return last_metrics
+    # the exit reason rides the metrics dict so main() can map it onto the
+    # supervisor exit-code protocol (host_lost -> EXIT_ELASTIC, ...)
+    return {**last_metrics, "_exit_reason": engine.exit_reason}
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1408,11 +1663,120 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="this process's index for --distributed with --coordinator",
     )
+    parser.add_argument(
+        "--elastic",
+        type=int,
+        default=0,
+        metavar="N",
+        help="supervise N local training processes instead of training in "
+        "this one: dead/wedged hosts trigger a budgeted relaunch from the "
+        "last committed checkpoint at the surviving world size, with a "
+        "rejoin back to N once the budget and timer allow "
+        "(train/elastic.py; budgets under run.elastic_*)",
+    )
     return parser
+
+
+def _run_elastic(args) -> int:
+    """``--elastic N``: run the :class:`ElasticSupervisor` over N child
+    training processes on localhost. Each generation gets a fresh gloo
+    coordinator port; every child is forced to ``run.resume=true`` so a
+    relaunch continues from the last committed checkpoint (a fresh run
+    simply finds no checkpoint). Returns the supervisor's exit code."""
+    import socket
+    import subprocess
+    import sys
+
+    from jumbo_mae_tpu_tpu.train.elastic import ElasticSupervisor
+
+    cfg = load_config(args.config, args.overrides)
+    run = cfg.run
+    world = int(args.elastic)
+    if run.train_batch_size % world:
+        raise ValueError(
+            f"--elastic {world} must divide run.train_batch_size "
+            f"({run.train_batch_size}) — and so must every DOWNSIZED world "
+            "the supervisor may relaunch at"
+        )
+    run_dir = Path(run.output_dir) / run.name
+    run_dir.mkdir(parents=True, exist_ok=True)
+    # the supervisor shares host-0's journal DIRECTORY but owns a fresh
+    # segment (RunJournal always opens max+1), so its role="supervisor"
+    # rows interleave cleanly under read_merged_journal
+    journal = RunJournal(run_dir / "journal") if run.journal else None
+
+    def _free_port() -> int:
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+        finally:
+            s.close()
+
+    base = [sys.executable, "-m", "jumbo_mae_tpu_tpu.cli.train"]
+    if args.config:
+        base += ["--config", args.config]
+    for ov in args.overrides or []:
+        base += ["--set", ov]
+
+    def launch(world_size: int, gen: int) -> list:
+        port = _free_port()
+        procs = []
+        for i in range(world_size):
+            procs.append(
+                subprocess.Popen(
+                    base
+                    + [
+                        "--set",
+                        "run.resume=true",
+                        "--distributed",
+                        "--coordinator",
+                        f"127.0.0.1:{port}",
+                        "--num-processes",
+                        str(world_size),
+                        "--process-id",
+                        str(i),
+                    ]
+                )
+            )
+        print(
+            f"[elastic] generation {gen}: world={world_size} "
+            f"on 127.0.0.1:{port} (pids {[p.pid for p in procs]})"
+        )
+        return procs
+
+    sup = ElasticSupervisor(
+        run_dir=run_dir,
+        world_size=world,
+        launch=launch,
+        max_restarts=run.elastic_max_restarts,
+        backoff_s=run.elastic_backoff_s,
+        backoff_cap_s=run.elastic_backoff_cap_s,
+        rejoin_after_s=run.elastic_rejoin_after_s,
+        wedge_after_s=run.elastic_wedge_after_s,
+        journal=journal,
+    )
+    import signal
+
+    def _stop(signum, frame):
+        print(f"[elastic] caught signal {signum}: draining the fleet")
+        sup.request_stop()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _stop)
+    try:
+        rc = sup.run()
+    finally:
+        if journal is not None:
+            journal.close()
+    print(f"[elastic] supervisor exiting {rc}")
+    return rc
 
 
 def main(argv: list[str] | None = None):
     args = build_parser().parse_args(argv)
+    if args.elastic:
+        raise SystemExit(_run_elastic(args))
     if args.distributed:
         if os.environ.get("JAX_PLATFORMS", "") == "cpu":
             # multi-process CPU (the CI fleet smoke): cross-process
@@ -1429,8 +1793,21 @@ def main(argv: list[str] | None = None):
         else:
             jax.distributed.initialize()
     cfg = load_config(args.config, args.overrides)
-    metrics = train(cfg)
+    try:
+        metrics = train(cfg)
+    except DivergenceError as e:
+        # deterministic failure: exit EXIT_FATAL so a supervisor does not
+        # burn its restart budget re-proving the divergence
+        print(f"[train] FATAL: {e}")
+        raise SystemExit(EXIT_FATAL)
+    reason = "completed"
+    if isinstance(metrics, dict):
+        reason = str(metrics.pop("_exit_reason", "completed"))
     print("[train] done:", metrics)
+    code = exit_code_for(reason)
+    if code != EXIT_OK:
+        print(f"[train] exit reason {reason!r} -> exit code {code}")
+        raise SystemExit(code)
 
 
 if __name__ == "__main__":

@@ -131,6 +131,24 @@ class RunConfig:
     fleet_lag_steps: int = 2
     fleet_ratio: float = 1.5
     fleet_dead_after_s: float = 60.0
+    # elastic fleet training (train/elastic.py + obs/hangwatch.py): the hang
+    # watchdog kills a process whose step makes no progress for
+    # hangwatch_deadline_s seconds (0 = disabled; compile/eval/restore pause
+    # it via expected() windows) with EXIT_HANG so the supervisor restarts
+    # it. The supervisor (cli/train.py --elastic N) restarts a broken fleet
+    # from the last committed checkpoint at the surviving world size, under
+    # a budget of elastic_max_restarts with exponential backoff
+    # (elastic_backoff_s doubling to elastic_backoff_cap_s); a host whose
+    # beacon goes stale for elastic_wedge_after_s while its process lives is
+    # treated as wedged supervisor-side; after a down-size, a graceful
+    # restart back to full world size is attempted every
+    # elastic_rejoin_after_s seconds.
+    hangwatch_deadline_s: float = 0.0
+    elastic_max_restarts: int = 8
+    elastic_backoff_s: float = 1.0
+    elastic_backoff_cap_s: float = 60.0
+    elastic_wedge_after_s: float = 0.0
+    elastic_rejoin_after_s: float = 30.0
     # memory observability (obs/memwatch.py): sample device/host memory per
     # log window (and per /metrics scrape when serving), journal mem_sample
     # snapshots, publish mem_* gauges, and run the leak sentinel — a robust

@@ -10,6 +10,12 @@ from jumbo_mae_tpu_tpu.data.loader import (
     valid_loader,
     valid_sample_stream,
 )
+from jumbo_mae_tpu_tpu.data.resize import (
+    ShardLedger,
+    epoch_shard_order,
+    merge_shard_states,
+    resize_assignment,
+)
 from jumbo_mae_tpu_tpu.data.shards import expand_shards, shuffle_shards, split_shards
 from jumbo_mae_tpu_tpu.data.synthetic import synthetic_batches
 from jumbo_mae_tpu_tpu.data.tario import (
@@ -20,12 +26,16 @@ from jumbo_mae_tpu_tpu.data.tario import (
 
 __all__ = [
     "DataConfig",
+    "ShardLedger",
     "StreamCursor",
     "TrainLoader",
     "batch_train_samples",
     "batch_valid_samples",
+    "epoch_shard_order",
     "expand_shards",
     "iter_shards_samples",
+    "merge_shard_states",
+    "resize_assignment",
     "iter_tar_samples",
     "prefetch_to_device",
     "shuffle_shards",

@@ -35,11 +35,14 @@ def _run(cfg_json: str) -> None:
         batch_train_samples,
         train_sample_stream,
     )
+    from jumbo_mae_tpu_tpu.data.resize import ShardLedger
 
     spec = json.loads(cfg_json)
     cfg = DataConfig(**spec["data"])
     start_epoch = spec.get("start_epoch", 0)
     cursor = StreamCursor(start_epoch, spec.get("skip_samples", 0))
+    ledger = ShardLedger()
+    override = spec.get("epoch_shard_override")
     stream = train_sample_stream(
         cfg,
         process_index=spec["process_index"],
@@ -49,10 +52,12 @@ def _run(cfg_json: str) -> None:
         start_epoch=start_epoch,
         skip_samples=spec.get("skip_samples", 0),
         cursor=cursor,
+        ledger=ledger,
+        epoch_shard_override=override,
     )
     out = sys.stdout.buffer
     for batch in batch_train_samples(
-        stream, spec["batch_size"], cfg.repeats, cursor=cursor
+        stream, spec["batch_size"], cfg.repeats, cursor=cursor, ledger=ledger
     ):
         payload = pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
         out.write(struct.pack(">Q", len(payload)))

@@ -184,6 +184,8 @@ def train_sample_stream(
     start_epoch: int = 0,
     skip_samples: int = 0,
     cursor: StreamCursor | None = None,
+    ledger=None,
+    epoch_shard_override: list | None = None,
 ) -> Iterator[tuple[np.ndarray, int]]:
     """Infinite (image, label) stream for one (process, worker) pair.
 
@@ -192,6 +194,14 @@ def train_sample_stream(
     (they define WHICH samples come next) but the augmentation transform —
     the expensive part — is skipped, and per-sample RNG keying keeps the
     remaining stream bit-identical to an uninterrupted one.
+
+    ``ledger`` (a :class:`~jumbo_mae_tpu_tpu.data.resize.ShardLedger`)
+    tracks which epoch shards have been FULLY yielded through the shuffle
+    buffer — the cursor a resized resume stripes the remainder from.
+    ``epoch_shard_override`` replaces the stream's shard stripe for the
+    STARTING epoch only (``(global_index, url)`` pairs from
+    :func:`~jumbo_mae_tpu_tpu.data.resize.resize_assignment`); later
+    epochs stripe normally at the current topology.
     """
     shards = expand_shards(cfg.train_shards)
     transform = TrainTransform(cfg)
@@ -212,35 +222,51 @@ def train_sample_stream(
         rng = np.random.default_rng(
             (cfg.seed, 1, process_index, worker_index, epoch)
         )
-        epoch_shards = split_shards(
-            shuffle_shards(shards, seed=cfg.seed, epoch=epoch),
-            process_index=process_index,
-            process_count=process_count,
-            worker_index=worker_index,
-            worker_count=worker_count,
-        )
+        order = shuffle_shards(shards, seed=cfg.seed, epoch=epoch)
+        if epoch_shard_override is not None and epoch == start_epoch:
+            epoch_pairs = [(int(g), str(u)) for g, u in epoch_shard_override]
+        else:
+            gidx = split_shards(
+                list(range(len(order))),  # type: ignore[arg-type]
+                process_index=process_index,
+                process_count=process_count,
+                worker_index=worker_index,
+                worker_count=worker_count,
+            )
+            epoch_pairs = [(g, order[g]) for g in gidx]
 
         def decoded():
-            for sample in iter_shards_samples(epoch_shards, retry=retry):
-                img_key = find_image_key(sample)
-                if img_key is None:
-                    continue
-                t0 = time.perf_counter()
-                payload = fault_point(
-                    "data.decode",
-                    key=str(sample.get("__key__", "")),
-                    data=sample[img_key],
-                )
-                img = decode_image(payload)  # type: ignore[arg-type]
-                m_decode.observe(time.perf_counter() - t0)
-                if img is None:
-                    m_decode_fail.inc()
-                    continue
-                label = decode_label(sample["cls"]) if "cls" in sample else -1
-                yield img, label
+            # one iter_shards_samples call per shard (instead of one for
+            # the whole stripe) so the ledger sees shard boundaries; retry
+            # and quarantine are per-shard in tario, so behavior is
+            # unchanged
+            for g, url in epoch_pairs:
+                for sample in iter_shards_samples([url], retry=retry):
+                    img_key = find_image_key(sample)
+                    if img_key is None:
+                        continue
+                    t0 = time.perf_counter()
+                    payload = fault_point(
+                        "data.decode",
+                        key=str(sample.get("__key__", "")),
+                        data=sample[img_key],
+                    )
+                    img = decode_image(payload)  # type: ignore[arg-type]
+                    m_decode.observe(time.perf_counter() - t0)
+                    if img is None:
+                        m_decode_fail.inc()
+                        continue
+                    label = decode_label(sample["cls"]) if "cls" in sample else -1
+                    if ledger is not None:
+                        ledger.note_read(epoch, g)
+                    yield g, (img, label)
+                if ledger is not None:
+                    ledger.note_read_done(epoch, g)
 
         idx = 0
-        for img, label in _shuffle_stream(decoded(), cfg.shuffle_buffer, rng):
+        for g, (img, label) in _shuffle_stream(decoded(), cfg.shuffle_buffer, rng):
+            if ledger is not None:
+                ledger.note_yield(epoch, g)
             for _ in range(cfg.repeats):
                 if to_skip > 0:
                     to_skip -= 1
@@ -387,11 +413,15 @@ def batch_train_samples(
     batch_size: int,
     repeats: int = 1,
     cursor: StreamCursor | None = None,
+    ledger=None,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Assemble train batches; de-interleave repeat clones. With ``cursor``
     (the SAME object the stream updates), each batch carries a ``_cursor``
     key — the (epoch, offset) reached after its last sample — so consumers
-    can checkpoint a sample-exact resume point."""
+    can checkpoint a sample-exact resume point. With ``ledger`` (the SAME
+    object the stream updates), each batch also carries a ``_shards`` key —
+    the consumed-shard snapshot as of its last sample — for resize-safe
+    elastic resume."""
     order = _deinterleave(batch_size, max(1, repeats))
     while True:
         pairs = [next(stream) for _ in range(batch_size)]
@@ -400,6 +430,8 @@ def batch_train_samples(
         batch = {"images": images, "labels": labels}
         if cursor is not None:
             batch["_cursor"] = (cursor.epoch, cursor.offset)
+        if ledger is not None:
+            batch["_shards"] = ledger.snapshot()
         yield batch
 
 
@@ -519,6 +551,7 @@ class TrainLoader:
         process_count: int = 1,
         start_epoch: int = 0,
         cursor: dict | None = None,
+        epoch_shard_override: list | None = None,
     ):
         if batch_size % max(1, cfg.repeats):
             raise ValueError(
@@ -528,6 +561,7 @@ class TrainLoader:
         self.cfg = cfg
         self.batch_size = batch_size
         self._workers: list[_Worker] = []
+        self._shard_states: list = []
         # loader telemetry (obs/metrics.py): how long the train loop waits
         # for batches, and whether workers are stalling or dying under it
         reg = get_registry()
@@ -550,6 +584,14 @@ class TrainLoader:
             # round-robin merge makes this stream a pure function of
             # (config, native_io_threads) — but only for the SAME thread
             # count, so a cursor records it and resume validates it
+            if epoch_shard_override is not None:
+                raise ValueError(
+                    "resize-consistent resume (epoch_shard_override) is not "
+                    "supported by the native-IO loader — the reader merges "
+                    "per-thread queues without shard-boundary accounting; "
+                    "restart with data.use_native=false or fall back to "
+                    "epoch resume"
+                )
             if cursor is not None:
                 saved_threads = cursor.get("native_threads")
                 if saved_threads is None:
@@ -605,7 +647,11 @@ class TrainLoader:
             starts = [(start_epoch, 0)] * n_streams
             self.batches_yielded = 0
         self._cursors = list(starts)
+        self._shard_states = [None] * n_streams
         if cfg.workers <= 0:
+            from jumbo_mae_tpu_tpu.data.resize import ShardLedger
+
+            led = ShardLedger()
             track = StreamCursor(*starts[0])
             self._stream = train_sample_stream(
                 cfg,
@@ -614,9 +660,11 @@ class TrainLoader:
                 start_epoch=starts[0][0],
                 skip_samples=starts[0][1],
                 cursor=track,
+                ledger=led,
+                epoch_shard_override=epoch_shard_override,
             )
             self._inline = batch_train_samples(
-                self._stream, batch_size, cfg.repeats, cursor=track
+                self._stream, batch_size, cfg.repeats, cursor=track, ledger=led
             )
             return
         self._inline = None
@@ -634,6 +682,13 @@ class TrainLoader:
                 "start_epoch": starts[w][0],
                 "skip_samples": starts[w][1],
             }
+            if epoch_shard_override is not None:
+                # worker w owns every W-th pair of the process's remainder
+                # stripe — same [w::W] discipline as split_shards
+                spec["epoch_shard_override"] = [
+                    [int(g), str(u)]
+                    for g, u in epoch_shard_override[w :: cfg.workers]
+                ]
             self._workers.append(_Worker(spec, per_worker_q))
 
     def snapshot(self) -> dict | None:
@@ -649,6 +704,18 @@ class TrainLoader:
         if getattr(self, "_native_threads", None) is not None:
             snap["native_threads"] = self._native_threads
         return snap
+
+    def shard_snapshot(self) -> dict | None:
+        """Merged consumed-shard state across this process's streams, as of
+        the last batch returned by ``__next__`` — the per-host payload of
+        the ``shard_cursor`` journal event a resized resume reads. ``None``
+        on the native path (no shard-boundary accounting)."""
+        if not self._shard_states:
+            return None
+        from jumbo_mae_tpu_tpu.data.resize import merge_shard_states
+
+        merged = merge_shard_states(self._shard_states)
+        return {"epochs": {str(e): sorted(v) for e, v in merged.items()}}
 
     def __iter__(self):
         return self
@@ -686,6 +753,9 @@ class TrainLoader:
         cur = batch.pop("_cursor", None)
         if cur is not None:
             self._cursors[slot] = (int(cur[0]), int(cur[1]))
+        sh = batch.pop("_shards", None)
+        if sh is not None and self._shard_states:
+            self._shard_states[slot] = sh
         self.batches_yielded += 1
         return batch
 

@@ -46,6 +46,7 @@ All selectors of a rule must match for it to fire. Examples::
     data.decode:delay(0.2)@host=1                 # straggle host 1 of a pod
     host.leak:corrupt(8)                          # leak 8 MB/step on the host
     batch.worker:raise@n<1                        # kill a batch-job worker mid-shard
+    fleet.wedge:delay(30)@host=1,n<1              # wedge host 1's step (hangwatch)
 
 The ``host=`` selector resolves the current process's host index lazily at
 fire time: an explicit :func:`set_host_index` (``cli/train.py`` pins it
@@ -56,7 +57,8 @@ else ``jax.process_index()`` when jax is already imported, else 0.
 Known sites (free-form names are allowed; these are the wired ones):
 ``data.shard_open``, ``data.decode``, ``train.loss``, ``train.grad``,
 ``serve.submit``, ``serve.replica``, ``serve.preempt``, ``ckpt.save``,
-``ckpt.load``, ``host.leak``, ``batch.worker``, ``publish.export``.
+``ckpt.load``, ``host.leak``, ``batch.worker``, ``publish.export``,
+``fleet.wedge``.
 
 ``serve.replica`` fires at the top of each replica's batched predict with
 ``key`` = the replica name (``r0``, ``r1``, …), so ``key~`` targets one
@@ -83,6 +85,12 @@ probe `obs/memwatch.py` registers so the attribution is testable.
 manifest's digests are sealed: ``corrupt(k)`` ships a poisoned artifact
 the watcher's manifest verification must quarantine, ``raise`` models a
 torn export (nothing commits — the atomic-rename contract under test).
+``fleet.wedge`` is the elastic-training hang site, ticked once per train
+step on the dispatch path (``key`` = step, OUTSIDE any hangwatch
+``expected()`` window): ``delay(s)`` past ``run.hangwatch_deadline_s``
+holds that host's step so the survivors block in the next collective —
+the wedged-all-reduce failure the hang watchdog must convert into an
+``EXIT_HANG`` death the :class:`ElasticSupervisor` restarts.
 """
 
 from __future__ import annotations
@@ -125,6 +133,7 @@ KNOWN_SITES = (
     "host.leak",
     "batch.worker",
     "publish.export",
+    "fleet.wedge",
 )
 
 

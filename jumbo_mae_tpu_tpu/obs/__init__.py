@@ -34,6 +34,9 @@
 - ``obs.lockwatch`` — opt-in instrumented locks (``GRAFT_LOCKWATCH=1``):
   runtime lock-order inversion + long-hold detection, ``lock_*`` metrics,
   ``lock_order_violation`` journal events.
+- ``obs.hangwatch`` — step-deadline hang watchdog: converts a wedged
+  collective into a fast ``EXIT_HANG`` death the elastic supervisor can
+  restart (``hang_detected`` journal event, bounded checkpoint drain).
 - ``obs.retrace``  — retrace sentinel: hooks JAX compile telemetry and
   turns any post-warmup recompile into a ``retrace`` journal event with
   shape/dtype-diff attribution.
@@ -49,6 +52,7 @@ modules remain as import-compatible shims over this package.
 from jumbo_mae_tpu_tpu.obs.exporter import HealthState, TelemetryServer
 from jumbo_mae_tpu_tpu.obs.fleet import FleetAggregator, HostBeacon, read_beacons
 from jumbo_mae_tpu_tpu.obs.flightrec import FlightRecorder
+from jumbo_mae_tpu_tpu.obs.hangwatch import HangWatchdog
 from jumbo_mae_tpu_tpu.obs.journal import (
     JOURNAL_EVENTS,
     RunJournal,
@@ -156,6 +160,7 @@ __all__ = [
     "FleetAggregator",
     "FlightRecorder",
     "Gauge",
+    "HangWatchdog",
     "HostBeacon",
     "HealthState",
     "Histogram",

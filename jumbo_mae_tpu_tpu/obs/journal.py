@@ -90,6 +90,15 @@ JOURNAL_EVENTS = frozenset(
         "publish",
         "publish_skipped",
         "publish_failed",
+        # elastic fleet training (train/elastic.py + cli/train.py)
+        "hang_detected",
+        "host_lost",
+        "elastic_restart",
+        "elastic_resize",
+        "elastic_rejoin",
+        "elastic_exhausted",
+        "ckpt_fallback",
+        "shard_cursor",
     }
 )
 

@@ -5,8 +5,14 @@ from jumbo_mae_tpu_tpu.train.checkpoint import (
     import_params_msgpack,
     load_pretrained_params,
 )
+from jumbo_mae_tpu_tpu.train.elastic import ElasticSupervisor
 from jumbo_mae_tpu_tpu.train.engine import (
+    EXIT_ELASTIC,
+    EXIT_FATAL,
+    EXIT_HANG,
+    EXIT_OK,
     CheckpointEvent,
+    exit_code_for,
     LogWindow,
     RunEngine,
     StepEvent,
@@ -26,6 +32,12 @@ __all__ = [
     "import_params_msgpack",
     "load_pretrained_params",
     "CheckpointEvent",
+    "ElasticSupervisor",
+    "EXIT_ELASTIC",
+    "EXIT_FATAL",
+    "EXIT_HANG",
+    "EXIT_OK",
+    "exit_code_for",
     "LogWindow",
     "RunEngine",
     "StepEvent",

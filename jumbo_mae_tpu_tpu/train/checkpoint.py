@@ -263,24 +263,60 @@ class Checkpointer:
         sharding: Any = None,
         step: int | None = None,
         which: str = "last",
+        fallback_steps: int = 0,
+        on_fallback=None,
     ):
         """Restore ``(state, extra)``. ``template`` is a live state or
         eval_shape tree defining structure/dtypes; ``sharding`` (same tree of
-        NamedShardings) places arrays directly on the mesh."""
+        NamedShardings) places arrays directly on the mesh.
+
+        ``fallback_steps > 0`` makes the restore survivable: when the
+        resolved step fails to load (torn/corrupt save — e.g. the writing
+        host was SIGKILLed mid-commit), the restore walks back through up
+        to ``fallback_steps`` earlier committed steps instead of crashing
+        the relaunch. ``on_fallback(from_step, to_step, error)`` fires per
+        hop (the train CLI journals it as ``ckpt_fallback``). The walk is
+        bounded — a store where every step is bad still raises. The
+        ``ckpt.load`` fault site fires per attempt with the step as key.
+        """
         mgr, step = self._resolve(which, step)
         tmpl, _ = split_rng_for_save(template)
         abstract = abstract_state(tmpl, sharding)
-        _warn_on_dtype_casts(mgr, step, abstract)
-        out = mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                extra=ocp.args.JsonRestore(),
-            ),
-        )
-        extra = out["extra"] or {}
-        state = rejoin_rng(out["state"], extra.get("_rng_typed", False))
-        return state, extra
+        steps = [step]
+        if fallback_steps > 0:
+            older = sorted(
+                (s for s in mgr.all_steps() if s < step), reverse=True
+            )
+            steps += older[: max(0, int(fallback_steps))]
+        from jumbo_mae_tpu_tpu.faults.inject import fault_point
+
+        last_err: Exception | None = None
+        for i, s in enumerate(steps):
+            if i > 0 and on_fallback is not None:
+                on_fallback(steps[i - 1], s, last_err)
+            try:
+                fault_point("ckpt.load", key=str(s))
+                _warn_on_dtype_casts(mgr, s, abstract)
+                out = mgr.restore(
+                    s,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(abstract),
+                        extra=ocp.args.JsonRestore(),
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 - each step gets one shot
+                if not steps[i + 1 :]:
+                    raise
+                last_err = e
+                print(
+                    f"[ckpt] restore of step {s} failed ({type(e).__name__}:"
+                    f" {e}); walking back"
+                )
+                continue
+            extra = out["extra"] or {}
+            state = rejoin_rng(out["state"], extra.get("_rng_typed", False))
+            return state, extra
+        raise last_err  # pragma: no cover - loop always raises or returns
 
     def restore_eval(
         self, template, *, sharding: Any = None, step: int | None = None,

@@ -1,0 +1,331 @@
+"""ElasticSupervisor: spawn, watch, and relaunch a multi-process fleet.
+
+PR 11 gave the fleet senses (beacons, lost/straggler detection,
+fleet_doctor); this module is the reflexes. The supervisor owns the N
+training processes of a local fleet (``cli/train.py --elastic N``) and
+makes host loss a survivable, journaled, budgeted event:
+
+- a child that dies by signal (SIGKILL'd host), exits ``EXIT_HANG`` (its
+  hang watchdog converted a wedged collective into a death), or exits
+  ``EXIT_ELASTIC`` (it observed a peer's beacon go stale) triggers a fleet
+  restart: survivors are drained (SIGTERM → grace → SIGKILL — a process
+  blocked in a dead collective cannot run its preemption checkpoint, the
+  last *committed* checkpoint is the resume point), world size is
+  recomputed without the failed slots, and the fleet relaunches from the
+  last committed checkpoint;
+- restarts are budgeted: ``max_restarts`` with exponential backoff
+  (``backoff_s`` doubling to ``backoff_cap_s``); exhaustion journals
+  ``elastic_exhausted`` with a verdict and exits nonzero;
+- ``EXIT_FATAL`` (diverged, config error) is never retried — restarting a
+  deterministic crash just burns the budget proving it again;
+- after a down-size, the supervisor attempts a *rejoin* every
+  ``rejoin_after_s``: graceful teardown (children checkpoint and exit
+  clean) and relaunch at full world size, journaled ``elastic_rejoin``;
+- with ``wedge_after_s > 0`` the supervisor also reads the fleet beacon
+  dir itself and treats an alive child whose beacon is stale as wedged —
+  the backstop for a hang the in-process watchdog cannot see (e.g. the
+  watchdog thread itself starved).
+
+The supervisor shares the run's journal *directory* with host 0 but owns
+its own segment file (``RunJournal`` writers always open a fresh
+max+1-indexed segment), so ``read_merged_journal`` interleaves supervisor
+events (``role="supervisor"``) with the hosts' without coordination.
+
+Everything time-related is injectable (``clock``/``sleep_fn``) so the
+restart/backoff/rejoin state machine is unit-testable without subprocesses
+(the launch callback is just a factory returning ``Popen``-shaped
+objects).
+"""
+
+from __future__ import annotations
+
+import signal
+import time
+from pathlib import Path
+from typing import Callable
+
+from jumbo_mae_tpu_tpu.obs.metrics import get_registry
+from jumbo_mae_tpu_tpu.train.engine import (
+    EXIT_ELASTIC,
+    EXIT_FATAL,
+    EXIT_HANG,
+)
+
+#: teardown reasons that remove the failed slots from the next world size
+#: (the "machine" is presumed bad until the rejoin timer says otherwise)
+_DOWNSIZE_REASONS = frozenset({"host_dead", "hang", "host_lost", "wedged"})
+
+
+class ElasticSupervisor:
+    """Budgeted restart supervisor for a local training fleet.
+
+    ``launch(world_size, gen)`` spawns the fleet's processes and returns
+    them as a list indexed by process id — each needs only the ``Popen``
+    surface (``poll``, ``send_signal``, ``kill``, ``wait``,
+    ``returncode``, ``pid``). A fresh coordinator port per generation is
+    the factory's job. ``run_dir`` locates the fleet beacon dir and the
+    shared journal.
+    """
+
+    def __init__(
+        self,
+        *,
+        run_dir: str | Path,
+        world_size: int,
+        launch: Callable[[int, int], list],
+        max_restarts: int = 8,
+        backoff_s: float = 1.0,
+        backoff_cap_s: float = 60.0,
+        rejoin_after_s: float = 30.0,
+        wedge_after_s: float = 0.0,
+        grace_s: float = 15.0,
+        poll_s: float = 0.2,
+        journal=None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ):
+        self.run_dir = Path(run_dir)
+        self.world_size = int(world_size)
+        self._launch = launch
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.rejoin_after_s = float(rejoin_after_s)
+        self.wedge_after_s = float(wedge_after_s)
+        self.grace_s = float(grace_s)
+        self.poll_s = float(poll_s)
+        self.journal = journal
+        self._clock = clock
+        self._sleep = sleep_fn
+        self.restarts_used = 0
+        self.generation = 0
+        self._stopping = False
+        reg = get_registry()
+        self._m_restarts = reg.counter(
+            "fleet_restarts_total",
+            "fleet relaunches by the elastic supervisor",
+            labels=("reason",),
+        )
+        self._m_rejoins = reg.counter(
+            "fleet_rejoins_total",
+            "graceful restarts back to full world size",
+        )
+        self._g_world = reg.gauge(
+            "fleet_world_size", "world size of the current fleet generation"
+        )
+
+    # -- journal helper --------------------------------------------------
+    def _emit(self, etype: str, **fields) -> None:
+        if self.journal is not None:
+            try:
+                self.journal.event(etype, role="supervisor", **fields)
+            except Exception:  # noqa: BLE001 - journaling must not kill the loop
+                pass
+
+    def request_stop(self) -> None:
+        """SIGTERM-from-outside: drain the fleet and return cleanly."""
+        self._stopping = True
+
+    # -- process plumbing ------------------------------------------------
+    def _clean_beacons(self) -> None:
+        """Drop stale beacon files before a relaunch: the fleet dir
+        persists across generations, and a dead slot's old beacon would
+        read as a perpetually-lost host to the new generation's
+        aggregator (and to this supervisor's own wedge scan)."""
+        fleet = self.run_dir / "fleet"
+        if not fleet.is_dir():
+            return
+        for p in fleet.glob("host-*.json"):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+
+    def _teardown(self, procs: list, *, skip: set[int] = frozenset()) -> None:
+        """SIGTERM the fleet, grace, then SIGKILL stragglers. A child at a
+        stop-safe boundary checkpoints and exits clean; one blocked in a
+        dead collective cannot, and is killed — its progress since the
+        last committed checkpoint is the (bounded) replay cost."""
+        alive = [
+            (i, p)
+            for i, p in enumerate(procs)
+            if i not in skip and p.poll() is None
+        ]
+        for _, p in alive:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = self._clock() + self.grace_s
+        for _, p in alive:
+            while p.poll() is None and self._clock() < deadline:
+                self._sleep(self.poll_s)
+        for _, p in alive:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                try:
+                    p.wait(timeout=10)
+                except Exception:  # noqa: BLE001  # pragma: no cover
+                    pass
+
+    def _stale_hosts(self, procs: list) -> list[int]:
+        """Alive children whose beacon heartbeat is older than
+        ``wedge_after_s`` — the supervisor-side wedge detector."""
+        if self.wedge_after_s <= 0:
+            return []
+        from jumbo_mae_tpu_tpu.obs.fleet import read_beacons
+
+        beacons = read_beacons(self.run_dir / "fleet")
+        now = time.time()
+        out = []
+        for i, p in enumerate(procs):
+            if p.poll() is not None:
+                continue
+            b = beacons.get(i)
+            if b is None:
+                continue  # not started stepping yet — compile, restore
+            if now - float(b.get("heartbeat", now)) > self.wedge_after_s:
+                out.append(i)
+        return out
+
+    @staticmethod
+    def _classify(dead: dict[int, int]) -> tuple[str, list[int]]:
+        """(reason, failed slots) from the self-dead children's exit codes
+        — the children that died on their own, before any teardown. Signal
+        deaths dominate (a SIGKILL'd host often takes survivors down with
+        collective errors in the same poll window), then the protocol
+        codes, then generic crashes."""
+        if any(c == EXIT_FATAL for c in dead.values()):
+            return "fatal", [i for i, c in dead.items() if c == EXIT_FATAL]
+        sig = [i for i, c in dead.items() if c < 0]
+        if sig:
+            return "host_dead", sig
+        hang = [i for i, c in dead.items() if c == EXIT_HANG]
+        if hang:
+            return "hang", hang
+        lost = [i for i, c in dead.items() if c == EXIT_ELASTIC]
+        if lost:
+            # the exiting children are the *detectors*; the lost peer is
+            # whichever slot did NOT exit EXIT_ELASTIC — but from exit
+            # codes alone the detector set is what we know, so restart at
+            # the same world minus nothing and let beacons disambiguate.
+            return "host_lost", lost
+        return "crash", list(dead)
+
+    # -- the supervision loop --------------------------------------------
+    def run(self) -> int:
+        """Supervise until the run completes (0), a fatal exit (no retry),
+        or the restart budget is exhausted. Returns the supervisor's exit
+        code."""
+        backoff = self.backoff_s
+        world = self.world_size
+        downsized_at: float | None = None
+        self._g_world.set(world)
+        self._clean_beacons()
+        procs = self._launch(world, self.generation)
+        while True:
+            self._sleep(self.poll_s)
+            if self._stopping:
+                self._teardown(procs)
+                self._emit("shutdown", reason="supervisor_stop", world=world)
+                return 0
+
+            # ---- collect self-dead children ----------------------------
+            dead = {
+                i: p.returncode
+                for i, p in enumerate(procs)
+                if p.poll() is not None
+            }
+            if len(dead) == len(procs) and all(
+                c == 0 for c in dead.values()
+            ):
+                return 0  # run complete
+            abnormal = {i: c for i, c in dead.items() if c != 0}
+
+            # ---- supervisor-side wedge detection -----------------------
+            wedged = [] if abnormal else self._stale_hosts(procs)
+            if wedged:
+                for i in wedged:
+                    try:
+                        procs[i].kill()
+                        procs[i].wait(timeout=10)
+                    except Exception:  # noqa: BLE001  # pragma: no cover
+                        pass
+                reason, failed = "wedged", wedged
+            elif abnormal:
+                reason, failed = self._classify(abnormal)
+            else:
+                # ---- healthy; is a rejoin due? -------------------------
+                if (
+                    world < self.world_size
+                    and downsized_at is not None
+                    and self._clock() - downsized_at >= self.rejoin_after_s
+                ):
+                    self._teardown(procs)
+                    self.generation += 1
+                    self._emit(
+                        "elastic_rejoin",
+                        old_world=world,
+                        new_world=self.world_size,
+                        generation=self.generation,
+                    )
+                    self._m_rejoins.inc()
+                    world = self.world_size
+                    downsized_at = None
+                    self._g_world.set(world)
+                    self._clean_beacons()
+                    procs = self._launch(world, self.generation)
+                continue
+
+            # ---- a restartable (or fatal) failure ----------------------
+            self._teardown(procs, skip=set(dead))
+            if reason == "fatal":
+                self._emit(
+                    "elastic_exhausted",
+                    verdict="fatal child exit — not retryable",
+                    reason=reason,
+                    failed_hosts=failed,
+                    exit_codes={str(i): c for i, c in abnormal.items()},
+                    restarts_used=self.restarts_used,
+                )
+                return EXIT_FATAL
+            if self.restarts_used >= self.max_restarts:
+                self._emit(
+                    "elastic_exhausted",
+                    verdict=(
+                        f"restart budget exhausted after {self.restarts_used}"
+                        f" restarts (max {self.max_restarts})"
+                    ),
+                    reason=reason,
+                    failed_hosts=failed,
+                    restarts_used=self.restarts_used,
+                )
+                return EXIT_FATAL
+            self.restarts_used += 1
+            new_world = world
+            if reason in _DOWNSIZE_REASONS:
+                new_world = max(1, world - len(failed))
+            self._sleep(backoff)
+            backoff = min(self.backoff_cap_s, backoff * 2)
+            self.generation += 1
+            self._emit(
+                "elastic_restart",
+                reason=reason,
+                failed_hosts=failed,
+                exit_codes={str(i): c for i, c in abnormal.items()},
+                old_world=world,
+                new_world=new_world,
+                generation=self.generation,
+                restarts_used=self.restarts_used,
+                backoff_s=round(backoff, 3),
+            )
+            self._m_restarts.labels(reason).inc()
+            if new_world < world:
+                downsized_at = self._clock()
+            world = new_world
+            self._g_world.set(world)
+            self._clean_beacons()
+            procs = self._launch(world, self.generation)

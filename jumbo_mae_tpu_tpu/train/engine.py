@@ -45,6 +45,36 @@ from __future__ import annotations
 
 from typing import Callable
 
+# -- exit-code protocol ---------------------------------------------------
+# The ElasticSupervisor (train/elastic.py) classifies a dead child by its
+# exit code. Codes are chosen outside the shell/signal ranges (1, 126-128,
+# 128+N) so a supervisor can tell "the run asked to be restarted" from "the
+# run tripped over a bug" from "the kernel killed it".
+EXIT_OK = 0  # completed (or clean preemption checkpoint + exit)
+EXIT_ELASTIC = 42  # host lost / membership change: restart me at new world
+EXIT_FATAL = 43  # unrecoverable (diverged, config error): do NOT restart
+EXIT_HANG = 44  # hang watchdog fired: a collective is wedged, restart me
+
+#: exit reasons that must NOT be retried by a supervisor.
+FATAL_REASONS = frozenset({"diverged"})
+
+
+def exit_code_for(reason: str) -> int:
+    """Map an engine ``exit_reason`` to the supervisor exit-code protocol.
+
+    ``completed``/``preempted``/``stopped`` are clean exits; ``host_lost``
+    asks for an elastic restart; reasons in :data:`FATAL_REASONS` (and any
+    ``exception:*``) are fatal — the supervisor gives up rather than loop
+    on a deterministic crash.
+    """
+    if reason in ("completed", "preempted", "stopped"):
+        return EXIT_OK
+    if reason == "host_lost":
+        return EXIT_ELASTIC
+    if reason == "hang":
+        return EXIT_HANG
+    return EXIT_FATAL
+
 
 class StepEvent:
     """One dispatched step; ``metrics`` may still live on device and is
@@ -135,6 +165,8 @@ class RunEngine:
         self._on_checkpoint: list = []
         self._on_crash: list = []
         self._on_shutdown: list = []
+        self._on_host_lost: list = []
+        self.host_lost_info: dict | None = None
 
     # -- hook registration (usable as decorators; registration order is
     # -- execution order) ------------------------------------------------
@@ -170,6 +202,14 @@ class RunEngine:
         self._on_shutdown.append(fn)
         return fn
 
+    def on_host_lost(self, fn):
+        """``fn(engine, info)`` — fired once at the stop-safe boundary
+        after :meth:`notify_host_lost`, BEFORE the preemption checkpoint,
+        so journal/flightrec/beacon hooks can record the membership change
+        while the step context still exists."""
+        self._on_host_lost.append(fn)
+        return fn
+
     # -- control requests (called from hooks) ----------------------------
     def request_rollback(self) -> None:
         """Ask the driver to run the rollback chain after the current log
@@ -181,6 +221,18 @@ class RunEngine:
         """Ask the driver to exit at the next stop-safe boundary with
         ``exit_reason=reason`` (checkpointing first, like preemption)."""
         self._stop_reason = reason
+
+    def notify_host_lost(self, info: dict | None = None) -> None:
+        """A fleet peer is gone (dead beacon / supervisor signal). Records
+        ``info`` (e.g. ``{"hosts": [1], "detected_by": "beacon"}``), fires
+        the ``on_host_lost`` chain at the next stop-safe boundary, then
+        exits with ``exit_reason="host_lost"`` → :data:`EXIT_ELASTIC`.
+
+        The loop cannot keep stepping: the next collective would block on
+        the dead peer forever. First notification wins."""
+        if self._stop_reason != "host_lost":
+            self.host_lost_info = dict(info or {})
+            self.request_stop("host_lost")
 
     # -- boundaries ------------------------------------------------------
     def at_log_boundary(self, step: int) -> bool:
@@ -265,14 +317,27 @@ class RunEngine:
                     self._stop_reason is not None
                     or (self._should_stop is not None and self._should_stop())
                 ):
-                    if not saved_this_step:
-                        cev = CheckpointEvent(step, None, reason="preemption")
-                        for fn in self._on_checkpoint:
-                            fn(self, cev)
-                    print(
-                        f"[train] preemption checkpoint at step {step}; "
-                        "exiting"
-                    )
+                    if self._stop_reason == "host_lost":
+                        for fn in self._on_host_lost:
+                            fn(self, self.host_lost_info or {})
+                        # no preemption save: a checkpoint is collective and
+                        # the lost peer can never join it — the last
+                        # COMMITTED checkpoint is the elastic resume point
+                        print(
+                            f"[train] host lost at step {step}; exiting "
+                            "for elastic restart"
+                        )
+                    else:
+                        if not saved_this_step:
+                            cev = CheckpointEvent(
+                                step, None, reason="preemption"
+                            )
+                            for fn in self._on_checkpoint:
+                                fn(self, cev)
+                        print(
+                            f"[train] preemption checkpoint at step {step}; "
+                            "exiting"
+                        )
                     self.exit_reason = self._stop_reason or "preempted"
                     break
         except BaseException as e:

@@ -403,7 +403,7 @@ def test_synthetic_iterators_respect_model_label_count(devices):
         ],
     )
     mesh = create_mesh(MeshConfig(data=1, fsdp=1))
-    it, _, _ = make_train_iterator(cfg, mesh, 8, num_labels=10)
+    it, _, _, _ = make_train_iterator(cfg, mesh, 8, num_labels=10)
     batch = next(it)
     labels = jax.device_get(batch["labels"])
     assert labels.max() < 10 and labels.min() >= 0, labels

@@ -1,0 +1,819 @@
+"""Elastic fleet training: hang watchdog, supervised restart, and
+resize-consistent resume.
+
+Everything here except the subprocess chaos test runs without processes or
+threads: the watchdog exposes ``check(now)`` for fake-clock driving, the
+supervisor takes injectable ``clock``/``sleep_fn``/``launch``, and the
+resize assignment is a pure function. The ``slow``-marked chaos test is the
+real thing — a 2-process gloo fleet under ``--elastic 2``, one host
+SIGKILLed, supervisor restarts at world 1 and rejoins at world 2.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from jumbo_mae_tpu_tpu.data.resize import (
+    ShardLedger,
+    epoch_shard_order,
+    merge_shard_states,
+    resize_assignment,
+)
+from jumbo_mae_tpu_tpu.obs import hangwatch as hw_mod
+from jumbo_mae_tpu_tpu.obs.hangwatch import HangWatchdog
+from jumbo_mae_tpu_tpu.train.elastic import ElasticSupervisor
+from jumbo_mae_tpu_tpu.train.engine import (
+    EXIT_ELASTIC,
+    EXIT_FATAL,
+    EXIT_HANG,
+    EXIT_OK,
+    exit_code_for,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------ fakes
+
+
+class FakeClock:
+    """Monotonic clock advanced only by the supervisor's own sleeps."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeProc:
+    """Popen surface: scripted self-death plus signal bookkeeping."""
+
+    def __init__(self, clock, *, dies_at=None, rc=None, pid=1000):
+        self._clock = clock
+        self.dies_at = dies_at
+        self._rc = rc
+        self.returncode = None
+        self.pid = pid
+        self.signals: list = []
+
+    def poll(self):
+        if (
+            self.returncode is None
+            and self.dies_at is not None
+            and self._clock() >= self.dies_at
+        ):
+            self.returncode = self._rc
+        return self.returncode
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        if self.returncode is None:
+            self.returncode = 0  # graceful: checkpoint + clean exit
+
+    def kill(self):
+        self.signals.append("KILL")
+        if self.returncode is None:
+            self.returncode = -9
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+class ScriptedLaunch:
+    """launch(world, gen) factory that replays scripted fleets in order
+    and records the (world, gen) of every call."""
+
+    def __init__(self, fleets):
+        self._fleets = list(fleets)
+        self.calls: list[tuple[int, int]] = []
+
+    def __call__(self, world: int, gen: int) -> list:
+        self.calls.append((world, gen))
+        fleet = self._fleets.pop(0)
+        return fleet(world, gen) if callable(fleet) else fleet
+
+
+class FakeJournal:
+    def __init__(self):
+        self.events: list[dict] = []
+
+    def event(self, etype, **fields):
+        self.events.append({"type": etype, **fields})
+
+    def of(self, etype):
+        return [e for e in self.events if e["type"] == etype]
+
+
+def make_supervisor(tmp_path, launch, clock, **kw):
+    kw.setdefault("world_size", 2)
+    kw.setdefault("max_restarts", 3)
+    kw.setdefault("backoff_s", 0.1)
+    kw.setdefault("backoff_cap_s", 0.4)
+    kw.setdefault("rejoin_after_s", 1e9)  # off unless a test opts in
+    kw.setdefault("grace_s", 1.0)
+    kw.setdefault("poll_s", 0.05)
+    journal = kw.pop("journal", FakeJournal())
+    sup = ElasticSupervisor(
+        run_dir=tmp_path,
+        launch=launch,
+        journal=journal,
+        clock=clock,
+        sleep_fn=clock.sleep,
+        **kw,
+    )
+    return sup, journal
+
+
+# ----------------------------------------------------- exit-code protocol
+
+
+class TestExitProtocol:
+    def test_exit_codes_distinct(self):
+        codes = {EXIT_OK, EXIT_ELASTIC, EXIT_FATAL, EXIT_HANG}
+        assert len(codes) == 4 and EXIT_OK == 0
+
+    def test_hangwatch_default_pinned_to_engine(self):
+        # obs must not import train; this test is the cross-layer pin
+        # keeping the two constants equal.
+        assert hw_mod.DEFAULT_EXIT_CODE == EXIT_HANG
+        src = (
+            REPO / "jumbo_mae_tpu_tpu" / "obs" / "hangwatch.py"
+        ).read_text()
+        assert "from jumbo_mae_tpu_tpu.train" not in src
+
+    def test_exit_code_for_reasons(self):
+        for reason in ("completed", "preempted", "stopped"):
+            assert exit_code_for(reason) == EXIT_OK
+        assert exit_code_for("host_lost") == EXIT_ELASTIC
+        assert exit_code_for("hang") == EXIT_HANG
+        assert exit_code_for("diverged") == EXIT_FATAL
+        assert exit_code_for("anything_else") == EXIT_FATAL
+
+
+# ------------------------------------------------------------- hangwatch
+
+
+class TestHangWatchdog:
+    def wd(self, deadline=10.0, **kw):
+        clock = FakeClock()
+        kw.setdefault("exit_fn", lambda code: None)
+        return HangWatchdog(deadline, clock=clock, **kw), clock
+
+    def test_unarmed_never_fires(self):
+        wd, clock = self.wd()
+        clock.t = 1e6
+        assert not wd.check() and not wd.fired
+
+    def test_fires_after_deadline_and_latches(self):
+        exits = []
+        wd, clock = self.wd(exit_fn=exits.append)
+        wd.arm()
+        clock.t = 9.9
+        assert not wd.check()
+        clock.t = 10.0
+        assert wd.check() and wd.fired
+        assert exits == [EXIT_HANG]
+        # latched: a racing second check must not re-fire
+        clock.t = 50.0
+        assert not wd.check()
+        assert exits == [EXIT_HANG]
+
+    def test_beat_resets_deadline(self):
+        wd, clock = self.wd()
+        wd.arm()
+        for t in (6.0, 12.0, 18.0):
+            clock.t = t
+            wd.beat(step=int(t))
+            assert not wd.check()
+        clock.t = 28.5
+        assert wd.check()
+
+    def test_expected_window_suspends_and_restarts_clock(self):
+        wd, clock = self.wd()
+        wd.arm()
+        with wd.expected("eval"):
+            clock.t = 100.0  # way past the deadline, but inside the window
+            assert not wd.check()
+        # the window close restarted the clock: no instant fire...
+        assert not wd.check()
+        clock.t = 109.0
+        assert not wd.check()
+        # ...but the deadline is live again afterwards
+        clock.t = 110.0
+        assert wd.check()
+
+    def test_expected_is_reentrant(self):
+        wd, clock = self.wd()
+        wd.arm()
+        with wd.expected("outer"):
+            with wd.expected("inner"):
+                clock.t = 99.0
+            clock.t = 199.0  # inner closed; outer still open
+            assert not wd.check()
+        clock.t = 208.0
+        assert not wd.check()
+        clock.t = 209.0
+        assert wd.check()
+
+    def test_on_fire_info_and_callback_exceptions_swallowed(self):
+        infos, exits = [], []
+        wd, clock = self.wd(exit_fn=exits.append)
+
+        @wd.on_fire
+        def boom(info):
+            infos.append(info)
+            raise RuntimeError("must not block the exit")
+
+        wd.arm()
+        wd.beat(step=7)
+        clock.t = 25.0
+        assert wd.check()
+        assert exits == [EXIT_HANG]
+        (info,) = infos
+        assert info["step"] == 7 and info["deadline_s"] == 10.0
+        assert info["stalled_s"] == pytest.approx(25.0)
+
+    def test_drain_runs_before_exit_and_is_bounded(self):
+        order = []
+        wd, clock = self.wd(
+            drain=lambda: order.append("drain"),
+            exit_fn=lambda code: order.append(("exit", code)),
+        )
+        wd.arm()
+        clock.t = 11.0
+        assert wd.check()
+        assert order == ["drain", ("exit", EXIT_HANG)]
+
+        # a wedged drain cannot turn the watchdog into a hang
+        order2 = []
+        wd2, clock2 = self.wd(
+            drain=lambda: time.sleep(60),
+            drain_timeout_s=0.1,
+            exit_fn=lambda code: order2.append(("exit", code)),
+        )
+        wd2.arm()
+        clock2.t = 11.0
+        t0 = time.monotonic()
+        assert wd2.check()
+        assert time.monotonic() - t0 < 5.0
+        assert order2 == [("exit", EXIT_HANG)]
+
+    def test_disarm_stops_enforcement(self):
+        wd, clock = self.wd()
+        wd.arm()
+        wd.disarm()
+        clock.t = 1e6
+        assert not wd.check()
+
+    def test_custom_exit_code(self):
+        exits = []
+        wd, clock = self.wd(exit_code=97, exit_fn=exits.append)
+        wd.arm()
+        clock.t = 11.0
+        wd.check()
+        assert exits == [97]
+
+
+# ------------------------------------------------- resize pure functions
+
+
+def _order(n=11, seed=3, epoch=0):
+    return epoch_shard_order(
+        [f"shard-{i:04d}.tar" for i in range(n)], seed=seed, epoch=epoch
+    )
+
+
+class TestResizeAssignment:
+    def test_epoch_order_deterministic_and_epoch_varying(self):
+        a, b = _order(epoch=1), _order(epoch=1)
+        assert a == b and sorted(a) == sorted(_order(epoch=2))
+        assert a != _order(epoch=2)  # different epoch, different order
+
+    @pytest.mark.parametrize("world", [1, 2, 3, 5])
+    def test_partition_disjoint_and_exhaustive(self, world):
+        order = _order()
+        consumed = {0, 4, 7}
+        got = [
+            resize_assignment(
+                order, consumed, world_size=world, process_id=p
+            )
+            for p in range(world)
+        ]
+        flat = list(itertools.chain.from_iterable(got))
+        assert len(flat) == len(set(i for i, _ in flat))  # disjoint
+        assert {i for i, _ in flat} == set(range(len(order))) - consumed
+        for i, url in flat:
+            assert order[i] == url
+
+    def test_worker_substriping_partitions_the_process_slice(self):
+        order = _order()
+        whole = resize_assignment(order, {1}, world_size=2, process_id=0)
+        parts = [
+            resize_assignment(
+                order, {1}, world_size=2, process_id=0,
+                worker_index=w, worker_count=3,
+            )
+            for w in range(3)
+        ]
+        assert sorted(itertools.chain.from_iterable(parts)) == sorted(whole)
+
+    def test_conservation_across_resize(self):
+        # ISSUE acceptance: consumed-before + assigned-after covers every
+        # shard of the epoch exactly once, for any old/new world pair.
+        order = _order(n=13)
+        consumed = {0, 2, 5, 12}
+        for new_world in (1, 2, 4):
+            after = set()
+            for p in range(new_world):
+                after |= {
+                    i
+                    for i, _ in resize_assignment(
+                        order, consumed, world_size=new_world, process_id=p
+                    )
+                }
+            assert consumed | after == set(range(13))
+            assert consumed & after == set()
+
+    def test_bad_inputs_raise(self):
+        order = _order()
+        with pytest.raises(ValueError):
+            resize_assignment(order, set(), world_size=2, process_id=2)
+        with pytest.raises(ValueError):
+            resize_assignment(
+                order, set(), world_size=1, process_id=0,
+                worker_index=1, worker_count=1,
+            )
+        with pytest.raises(ValueError, match="out of range"):
+            resize_assignment(order, {len(order)}, world_size=1, process_id=0)
+
+    def test_all_consumed_yields_empty(self):
+        order = _order(n=4)
+        assert (
+            resize_assignment(order, {0, 1, 2, 3}, world_size=2, process_id=0)
+            == []
+        )
+
+
+class TestShardLedger:
+    def test_promotes_only_when_reads_done_and_yielded(self):
+        led = ShardLedger()
+        for _ in range(3):
+            led.note_read(0, 5)
+        led.note_yield(0, 5)
+        led.note_yield(0, 5)
+        assert led.consumed == {}  # reads not done
+        led.note_read_done(0, 5)
+        assert led.consumed == {}  # one sample still in the buffer
+        led.note_yield(0, 5)
+        assert led.consumed == {0: [5]}
+
+    def test_empty_shard_promotes_on_read_done(self):
+        led = ShardLedger()
+        led.note_read_done(1, 9)  # quarantined/empty: zero samples
+        assert led.consumed == {1: [9]}
+
+    def test_snapshot_shape_and_merge(self):
+        a = ShardLedger()
+        a.note_read_done(0, 1)
+        a.note_read_done(1, 0)
+        b = ShardLedger()
+        b.note_read_done(0, 2)
+        snap = a.snapshot()
+        assert snap == {"epochs": {"0": [1], "1": [0]}}
+        merged = merge_shard_states([snap, b.snapshot(), None, {}])
+        assert merged == {0: {1, 2}, 1: {0}}
+
+
+# ------------------------------------------------- supervisor state machine
+
+
+class TestSupervisorClassify:
+    def test_priority_fatal_over_signal_over_hang_over_elastic(self):
+        c = ElasticSupervisor._classify
+        assert c({0: -9, 1: EXIT_FATAL}) == ("fatal", [1])
+        assert c({0: -9, 1: EXIT_HANG}) == ("host_dead", [0])
+        assert c({0: EXIT_HANG, 1: EXIT_ELASTIC}) == ("hang", [0])
+        assert c({0: EXIT_ELASTIC}) == ("host_lost", [0])
+        assert c({0: 1, 1: 2}) == ("crash", [0, 1])
+
+
+class TestSupervisorLoop:
+    def test_clean_completion_returns_zero(self, tmp_path):
+        clock = FakeClock()
+        launch = ScriptedLaunch(
+            [lambda w, g: [FakeProc(clock, dies_at=0.2, rc=0) for _ in range(w)]]
+        )
+        sup, journal = make_supervisor(tmp_path, launch, clock)
+        assert sup.run() == 0
+        assert launch.calls == [(2, 0)]
+        assert sup.restarts_used == 0
+        assert journal.of("elastic_restart") == []
+
+    def test_sigkill_downsizes_and_drains_survivor(self, tmp_path):
+        clock = FakeClock()
+        survivor = FakeProc(clock, pid=11)
+        fleets = [
+            lambda w, g: [survivor, FakeProc(clock, dies_at=0.0, rc=-9)],
+            lambda w, g: [FakeProc(clock, dies_at=clock() + 0.1, rc=0)],
+        ]
+        sup, journal = make_supervisor(tmp_path, ScriptedLaunch(fleets), clock)
+        launch = sup._launch
+        assert sup.run() == 0
+        assert launch.calls == [(2, 0), (1, 1)]
+        # the survivor was torn down (SIGTERM), not classified as failed
+        assert signal.SIGTERM in survivor.signals
+        (ev,) = journal.of("elastic_restart")
+        assert ev["reason"] == "host_dead"
+        assert ev["failed_hosts"] == [1]
+        assert ev["exit_codes"] == {"1": -9}
+        assert (ev["old_world"], ev["new_world"]) == (2, 1)
+        assert ev["generation"] == 1 and ev["restarts_used"] == 1
+
+    def test_crash_restarts_at_same_world(self, tmp_path):
+        clock = FakeClock()
+        fleets = [
+            lambda w, g: [
+                FakeProc(clock, dies_at=0.0, rc=1),
+                FakeProc(clock),
+            ],
+            lambda w, g: [
+                FakeProc(clock, dies_at=clock() + 0.1, rc=0) for _ in range(w)
+            ],
+        ]
+        sup, journal = make_supervisor(tmp_path, ScriptedLaunch(fleets), clock)
+        assert sup.run() == 0
+        assert sup._launch.calls == [(2, 0), (2, 1)]  # no downsize for crash
+        (ev,) = journal.of("elastic_restart")
+        assert ev["reason"] == "crash" and ev["new_world"] == 2
+
+    def test_fatal_exit_never_retried(self, tmp_path):
+        clock = FakeClock()
+        fleets = [
+            lambda w, g: [
+                FakeProc(clock, dies_at=0.0, rc=EXIT_FATAL),
+                FakeProc(clock),
+            ],
+        ]
+        sup, journal = make_supervisor(tmp_path, ScriptedLaunch(fleets), clock)
+        assert sup.run() == EXIT_FATAL
+        assert sup._launch.calls == [(2, 0)]  # no relaunch
+        (ev,) = journal.of("elastic_exhausted")
+        assert "not retryable" in ev["verdict"]
+        assert journal.of("elastic_restart") == []
+
+    def test_restart_budget_exhaustion(self, tmp_path):
+        clock = FakeClock()
+        crash = lambda w, g: [  # noqa: E731
+            FakeProc(clock, dies_at=clock(), rc=1) for _ in range(w)
+        ]
+        sup, journal = make_supervisor(
+            tmp_path, ScriptedLaunch([crash, crash]), clock, max_restarts=1
+        )
+        assert sup.run() == EXIT_FATAL
+        assert len(sup._launch.calls) == 2  # initial + the one budgeted retry
+        (ev,) = journal.of("elastic_exhausted")
+        assert "budget exhausted" in ev["verdict"]
+        assert ev["restarts_used"] == 1
+
+    def test_backoff_doubles_to_cap(self, tmp_path):
+        clock = FakeClock()
+        crash = lambda w, g: [  # noqa: E731
+            FakeProc(clock, dies_at=clock(), rc=1) for _ in range(w)
+        ]
+        sup, journal = make_supervisor(
+            tmp_path,
+            ScriptedLaunch([crash] * 5),
+            clock,
+            max_restarts=4,
+            backoff_s=0.1,
+            backoff_cap_s=0.4,
+        )
+        sup.run()
+        backoffs = [e["backoff_s"] for e in journal.of("elastic_restart")]
+        assert backoffs == [0.2, 0.4, 0.4, 0.4]  # journaled post-double, capped
+
+    def test_rejoin_after_timer(self, tmp_path):
+        clock = FakeClock()
+        healthy = FakeProc(clock, pid=20)
+        fleets = [
+            lambda w, g: [FakeProc(clock), FakeProc(clock, dies_at=0.0, rc=-9)],
+            lambda w, g: [healthy],
+            lambda w, g: [
+                FakeProc(clock, dies_at=clock() + 0.1, rc=0) for _ in range(w)
+            ],
+        ]
+        sup, journal = make_supervisor(
+            tmp_path, ScriptedLaunch(fleets), clock, rejoin_after_s=2.0
+        )
+        assert sup.run() == 0
+        assert [w for w, _ in sup._launch.calls] == [2, 1, 2]
+        # the down-sized generation was drained gracefully for the rejoin
+        assert signal.SIGTERM in healthy.signals
+        (ev,) = journal.of("elastic_rejoin")
+        assert (ev["old_world"], ev["new_world"]) == (1, 2)
+        assert ev["generation"] == 2
+
+    def test_wedged_host_killed_and_restarted(self, tmp_path):
+        clock = FakeClock()
+        fleet = tmp_path / "fleet"
+        fleet.mkdir()
+        wedged = FakeProc(clock, pid=30)
+
+        def gen0(w, g):
+            # beacon written "long ago" relative to wall time: the host
+            # heartbeated once and then stopped stepping
+            (fleet / "host-0.json").write_text(
+                json.dumps({"host": 0, "heartbeat": time.time() - 3600})
+            )
+            return [wedged]
+
+        fleets = [gen0, lambda w, g: [FakeProc(clock, dies_at=clock(), rc=0)]]
+        sup, journal = make_supervisor(
+            tmp_path,
+            ScriptedLaunch(fleets),
+            clock,
+            world_size=1,
+            wedge_after_s=1.0,
+        )
+        assert sup.run() == 0
+        assert "KILL" in wedged.signals
+        (ev,) = journal.of("elastic_restart")
+        assert ev["reason"] == "wedged" and ev["failed_hosts"] == [0]
+        # stale beacons were cleaned before each relaunch
+        assert list(fleet.glob("host-*.json")) == []
+
+    def test_request_stop_drains_and_exits_zero(self, tmp_path):
+        clock = FakeClock()
+        proc = FakeProc(clock)
+        sup, journal = make_supervisor(
+            tmp_path, ScriptedLaunch([[proc]]), clock, world_size=1
+        )
+        sup.request_stop()
+        assert sup.run() == 0
+        assert signal.SIGTERM in proc.signals
+        assert journal.of("shutdown")[0]["reason"] == "supervisor_stop"
+
+    def test_teardown_escalates_to_kill(self, tmp_path):
+        clock = FakeClock()
+
+        class Stubborn(FakeProc):
+            def send_signal(self, sig):
+                self.signals.append(sig)  # ignores SIGTERM
+
+        proc = Stubborn(clock)
+        sup, _ = make_supervisor(
+            tmp_path, ScriptedLaunch([[proc]]), clock, world_size=1, grace_s=0.2
+        )
+        sup._teardown([proc])
+        assert signal.SIGTERM in proc.signals and "KILL" in proc.signals
+        assert proc.returncode == -9
+
+
+# ---------------------------------------------- checkpoint restore fallback
+
+
+class TestRestoreFallback:
+    def _ckpt(self, tmp_path, keep=8):
+        from jumbo_mae_tpu_tpu.train.checkpoint import (
+            CheckpointConfig,
+            Checkpointer,
+        )
+
+        return Checkpointer(
+            CheckpointConfig(
+                str(tmp_path), async_save=False, max_keep_last=keep
+            )
+        )
+
+    def _state(self, x: float):
+        import jax.numpy as jnp
+
+        return {"w": jnp.full((4,), x, jnp.float32)}
+
+    def test_walks_back_past_bad_step(self, tmp_path):
+        from jumbo_mae_tpu_tpu import faults
+
+        ckpt = self._ckpt(tmp_path)
+        for s in (2, 4, 6):
+            ckpt.save(s, self._state(float(s)))
+        hops = []
+        # first ckpt.load attempt (step 6) raises; the walk lands on 4
+        faults.install_plan("ckpt.load:raise@n<1")
+        try:
+            state, extra = ckpt.restore(
+                self._state(0.0),
+                fallback_steps=2,
+                on_fallback=lambda frm, to, err: hops.append((frm, to, err)),
+            )
+        finally:
+            faults.clear_plan()
+        np.testing.assert_allclose(np.asarray(state["w"]), 4.0)
+        assert [(f, t) for f, t, _ in hops] == [(6, 4)]
+        assert hops[0][2] is not None
+
+    def test_walk_is_bounded(self, tmp_path):
+        from jumbo_mae_tpu_tpu import faults
+
+        ckpt = self._ckpt(tmp_path)
+        for s in (2, 4, 6):
+            ckpt.save(s, self._state(float(s)))
+        # every attempt fails: the bounded walk (6 -> 4) must still raise
+        faults.install_plan("ckpt.load:raise")
+        try:
+            with pytest.raises(Exception):
+                ckpt.restore(self._state(0.0), fallback_steps=1)
+        finally:
+            faults.clear_plan()
+
+    def test_no_fallback_by_default(self, tmp_path):
+        from jumbo_mae_tpu_tpu import faults
+
+        ckpt = self._ckpt(tmp_path)
+        ckpt.save(2, self._state(2.0))
+        ckpt.save(4, self._state(4.0))
+        faults.install_plan("ckpt.load:raise@n<1")
+        try:
+            with pytest.raises(Exception):
+                ckpt.restore(self._state(0.0))
+        finally:
+            faults.clear_plan()
+
+
+# ------------------------------------------------------ subprocess chaos
+
+
+def _train_cmd(*extra: str) -> list[str]:
+    return [
+        sys.executable,
+        "-m",
+        "jumbo_mae_tpu_tpu.cli.train",
+        "--config",
+        str(REPO / "recipes" / "smoke_cpu.yaml"),
+        *extra,
+    ]
+
+
+def _cpu_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _journal_events(run_dir: Path) -> list[dict]:
+    from jumbo_mae_tpu_tpu.obs.journal import read_merged_journal
+
+    try:
+        return read_merged_journal(run_dir)
+    except Exception:
+        return []
+
+
+@pytest.mark.slow
+def test_hangwatch_converts_wedge_to_exit_hang(tmp_path):
+    """fleet.wedge delays step 5 past the deadline; the watchdog journals
+    hang_detected, drains, and dies EXIT_HANG — the wedge never outlives
+    the deadline by more than the poll+drain slack."""
+    proc = subprocess.run(
+        _train_cmd(
+            "--set",
+            f"run.output_dir={tmp_path}",
+            "run.name=wedge",
+            "run.training_steps=8",
+            "optim.training_steps=8",
+            "optim.warmup_steps=1",
+            "run.log_interval=2",
+            "run.eval_interval=100",
+            "run.sanity_eval=false",
+            "run.hangwatch_deadline_s=4",
+            "run.faults=fleet.wedge:delay(300)@key~5,n<1",
+        ),
+        env=_cpu_env(),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == EXIT_HANG, proc.stdout[-2000:] + proc.stderr[-2000:]
+    evs = _journal_events(tmp_path / "wedge")
+    hangs = [e for e in evs if e.get("type") == "hang_detected"]
+    assert hangs, "hang_detected not journaled"
+    assert hangs[0]["stalled_s"] >= 4.0
+    assert "HANG" in proc.stdout
+
+
+@pytest.mark.slow
+def test_supervisor_sigkill_restart_and_rejoin(tmp_path):
+    """The full elastic loop, live: 2-process gloo fleet under --elastic 2,
+    host 1 SIGKILLed after the first committed checkpoint → supervisor
+    restarts at world 1 (resize-consistent resume from the world-2
+    checkpoint) → rejoins at world 2 → run completes, supervisor exits 0."""
+    from jumbo_mae_tpu_tpu.data.toy import write_toy_shards
+    from jumbo_mae_tpu_tpu.obs.fleet import read_beacons
+
+    urls = write_toy_shards(
+        tmp_path / "toy", n_train=256, n_val=32, shard_size=32, image_size=32
+    )
+    run_dir = tmp_path / "runs" / "el"
+    # children inherit the supervisor's stdout — log to a file, not a pipe
+    # the test never drains (a full pipe buffer would wedge the fleet)
+    sup_log = tmp_path / "sup.log"
+    log_f = sup_log.open("w")
+    sup = subprocess.Popen(
+        _train_cmd(
+            "--elastic",
+            "2",
+            "--set",
+            f"run.output_dir={tmp_path / 'runs'}",
+            "run.name=el",
+            "run.training_steps=24",
+            "optim.training_steps=24",
+            "optim.warmup_steps=1",
+            "run.log_interval=2",
+            "run.eval_interval=8",
+            "run.sanity_eval=false",
+            "run.synthetic_data=false",
+            f"data.train_shards={urls['train']}",
+            "data.dataset_size=256",
+            "data.shuffle_buffer=16",
+            "data.workers=0",
+            "mesh.data=-1",
+            "mesh.fsdp=1",
+            # generous dead/hang thresholds: on a loaded 1-CPU runner a
+            # healthy host's beacon can go stale for >10s across the
+            # post-rejoin recompile, and a false host_lost strands the
+            # survivor in gloo finalize for its full 300s timeout. The
+            # SIGKILL itself is seen immediately via the child's rc, so
+            # none of these slow the restart under test.
+            "run.fleet_dead_after_s=30",
+            "run.hangwatch_deadline_s=90",
+            "run.elastic_wedge_after_s=60",
+            "run.elastic_rejoin_after_s=15",
+            "run.elastic_backoff_s=0.5",
+        ),
+        env=_cpu_env(),
+        cwd=REPO,
+        stdout=log_f,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        # kill host 1 only once a checkpoint is COMMITTED — that is the
+        # restart's resume point; killing mid-compile just restarts fresh
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if any(
+                e.get("type") == "checkpoint_save"
+                for e in _journal_events(run_dir)
+            ) and 1 in read_beacons(run_dir / "fleet"):
+                break
+            assert sup.poll() is None, sup_log.read_text()[-3000:]
+            time.sleep(2)
+        else:
+            pytest.fail("no checkpoint_save journaled within 240s")
+        pid = read_beacons(run_dir / "fleet")[1]["pid"]
+        os.kill(pid, signal.SIGKILL)
+        sup.wait(timeout=600)
+    except BaseException:
+        sup.kill()
+        raise
+    finally:
+        log_f.close()
+    assert sup.returncode == 0, sup_log.read_text()[-3000:]
+
+    evs = _journal_events(run_dir)
+    restarts = [e for e in evs if e.get("type") == "elastic_restart"]
+    assert restarts and restarts[0]["reason"] == "host_dead"
+    assert restarts[0]["failed_hosts"] == [1]
+    assert (restarts[0]["old_world"], restarts[0]["new_world"]) == (2, 1)
+    # the down-sized generation resumed the world-2 checkpoint via the
+    # journal cursor, with exact shard accounting
+    resizes = [e for e in evs if e.get("type") == "elastic_resize"]
+    assert resizes, "no elastic_resize journaled on the world-2->1 resume"
+    assert 0 <= resizes[0]["shards_remaining"] <= resizes[0]["shards_total"]
+    rejoins = [e for e in evs if e.get("type") == "elastic_rejoin"]
+    assert rejoins and rejoins[0]["new_world"] == 2
+
+    # the offline doctor names the dead host and the supervisor's response
+    doc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "fleet_doctor.py"), str(run_dir)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert doc.returncode == 0
+    assert "elastic_restart" in doc.stdout and "host_dead" in doc.stdout

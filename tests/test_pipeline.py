@@ -436,6 +436,7 @@ def test_mesh_pipe_train_step_with_droppath(devices):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # heavy compile; full suite covers it
 def test_mesh_pipe_classify_train_step_matches_sequential(devices):
     """Round 5: pipeline parallelism covers the classify/finetune mode too
     (the classifier shares the JumboViT encoder; blocks_override threads
@@ -489,6 +490,7 @@ def test_mesh_pipe_classify_train_step_matches_sequential(devices):
     assert piped[-1] < piped[0]
 
 
+@pytest.mark.slow  # heavy compile; full suite covers it
 def test_mesh_pipe_decoder_stack_matches_sequential(devices):
     """Round 5: the MAE decoder stack is pipelinable too (its own
     blocks_override seam + make_plain_pipeline_apply). Encoder AND decoder

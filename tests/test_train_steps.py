@@ -116,6 +116,7 @@ class TestPretrainStep:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow  # heavy compile; full suite covers it
     def test_sharded_equals_single_device(self):
         batch = batch_of(16)
         _, s1, _, step1 = build(
@@ -139,6 +140,7 @@ class TestPretrainStep:
         for a, b in zip(p1, p8):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
+    @pytest.mark.slow  # heavy compile; full suite covers it
     def test_tensor_parallel_matches_single_device(self):
         # dp=2 × fsdp=2 × tp=2: heads and MLP hidden dims shard over
         # "tensor"; the step must still equal the single-device step.
@@ -237,6 +239,7 @@ class TestPretrainStep:
         assert "learning_rate" in metrics
         assert 0 < float(metrics["learning_rate"]) <= 1e-3
 
+    @pytest.mark.slow  # heavy compile; full suite covers it
     def test_grad_accum_matches_full_batch(self):
         full = batch_of(16, seed=3)
         split = jax.tree_util.tree_map(

@@ -213,6 +213,21 @@ def diagnose(beacons: dict[int, dict], events: list[dict], args) -> str:
     stragglers = [e for e in events if e.get("type") == "fleet_straggler"]
     lost_evs = [e for e in events if e.get("type") == "fleet_host_lost"]
     rejoins = [e for e in events if e.get("type") == "fleet_host_rejoined"]
+    # elastic supervision trail (train/elastic.py + the hang watchdog)
+    restart_evs = [
+        e
+        for e in events
+        if e.get("type")
+        in (
+            "elastic_restart",
+            "elastic_rejoin",
+            "elastic_resize",
+            "elastic_exhausted",
+            "hang_detected",
+            "host_lost",
+            "ckpt_fallback",
+        )
+    ]
 
     lines = ["# Fleet doctor report", ""]
 
@@ -262,6 +277,21 @@ def diagnose(beacons: dict[int, dict], events: list[dict], args) -> str:
             f"- straggler: **host {h}** — {sym} "
             f"({n} journaled straggler event(s); healthy in its final beacon)"
         )
+    # supervisor verdict lines: who failed and what the supervisor did
+    for e in restart_evs:
+        if e["type"] == "elastic_restart":
+            failed = ", ".join(
+                f"host {h}" for h in (e.get("failed_hosts") or [])
+            )
+            lines.append(
+                f"- restarted: **{failed or 'fleet'}** "
+                f"({e.get('reason')}) — supervisor relaunched generation "
+                f"{e.get('generation')} at world {e.get('new_world')} "
+                f"(was {e.get('old_world')}; restart "
+                f"{e.get('restarts_used')})"
+            )
+        elif e["type"] == "elastic_exhausted":
+            lines.append(f"- **supervisor gave up**: {e.get('verdict')}")
     # memory outliers are a flag, not a status: a leaking host still makes
     # lockstep progress, so it's named alongside — not instead of — the
     # straggler/lost verdicts
@@ -326,6 +356,62 @@ def diagnose(beacons: dict[int, dict], events: list[dict], args) -> str:
                 detail = (
                     f"host {e.get('host_id')} at step {e.get('step')} "
                     f"after {_fmt_num(e.get('lost_for_s', 0))}s"
+                )
+            lines.append(f"- +{dt:8.1f}s  `{etype}`  {detail}")
+    lines.append("")
+
+    # ---------------------------------------------------- restart timeline
+    # the elastic supervision trail: hangs detected, hosts lost, restarts,
+    # resizes, rejoins, fallback restores — the "what did the supervisor
+    # do about it" companion to the symptom timeline above
+    lines += ["## Restart timeline", ""]
+    if not restart_evs:
+        lines.append("(no elastic supervision events journaled)")
+    else:
+        t0 = min(e.get("ts", 0.0) for e in restart_evs)
+        for e in sorted(restart_evs, key=lambda e: e.get("ts", 0.0)):
+            dt = e.get("ts", t0) - t0
+            etype = e["type"]
+            if etype == "elastic_restart":
+                detail = (
+                    f"{e.get('reason')}: host(s) "
+                    f"{e.get('failed_hosts')} exit {e.get('exit_codes')} -> "
+                    f"generation {e.get('generation')} at world "
+                    f"{e.get('new_world')} (was {e.get('old_world')}), "
+                    f"restart {e.get('restarts_used')}"
+                )
+            elif etype == "elastic_rejoin":
+                detail = (
+                    f"world {e.get('old_world')} -> {e.get('new_world')} "
+                    f"(generation {e.get('generation')})"
+                )
+            elif etype == "elastic_resize":
+                detail = (
+                    f"host {e.get('host')} resumed step {e.get('step')} at "
+                    f"world {e.get('new_world')} (saved at "
+                    f"{e.get('old_world')}): {e.get('shards_remaining')}/"
+                    f"{e.get('shards_total')} epoch-{e.get('epoch')} shards "
+                    "left"
+                )
+            elif etype == "elastic_exhausted":
+                detail = str(e.get("verdict"))
+            elif etype == "hang_detected":
+                detail = (
+                    f"host {e.get('host')} stalled "
+                    f"{_fmt_num(e.get('stalled_s', 0))}s at step "
+                    f"{e.get('step')} (deadline "
+                    f"{_fmt_num(e.get('deadline_s', 0))}s)"
+                )
+            elif etype == "host_lost":
+                detail = (
+                    f"host {e.get('host')} saw peer(s) {e.get('hosts')} "
+                    f"lost via {e.get('detected_by')} at step {e.get('step')}"
+                )
+            else:  # ckpt_fallback
+                detail = (
+                    f"host {e.get('host')} walked back step "
+                    f"{e.get('from_step')} -> {e.get('to_step')} "
+                    f"({e.get('error')})"
                 )
             lines.append(f"- +{dt:8.1f}s  `{etype}`  {detail}")
     lines.append("")
