@@ -177,6 +177,7 @@ def make_train_iterator(
     data_cursor: dict | None = None,
     num_labels: int = 1000,
     shard_override: list | None = None,
+    shard_preconsumed: dict | None = None,
 ):
     """Build the device-prefetched train iterator.
 
@@ -193,7 +194,11 @@ def make_train_iterator(
     ``(global_index, url)`` pairs for this process's share of the resume
     epoch (computed by :func:`_resize_shard_override` from the journaled
     shard cursors), replacing the topology-derived stripe for that epoch
-    only.
+    only. ``shard_preconsumed`` rides with it — the merged consumed set
+    the override was derived from, seeded into the new generation's shard
+    ledgers so their ``shard_cursor`` snapshots stay CUMULATIVE across
+    generations (a second resize must subtract everything ever consumed,
+    not just this generation's reads).
 
     Returns ``(iterator, source, cursor_log, shard_log)`` — ``cursor_log``
     maps each absolute step to the loader snapshot after that step's batch
@@ -244,6 +249,7 @@ def make_train_iterator(
                 per_process,
                 cursor=data_cursor,
                 epoch_shard_override=shard_override,
+                shard_preconsumed=shard_preconsumed,
                 **loader_kwargs,
             )
             if data_cursor is not None:
@@ -259,6 +265,7 @@ def make_train_iterator(
                 cfg.data,
                 per_process,
                 epoch_shard_override=shard_override,
+                shard_preconsumed=shard_preconsumed,
                 **loader_kwargs,
             )
 
@@ -403,6 +410,18 @@ def _gather_data_cursor(snap: dict | None) -> dict | None:
     gathered = multihost_utils.process_allgather(
         np.asarray(snap["workers"], np.int64)
     )
+    # override marker: any-host semantics — if even one host's streams are
+    # still inside an epoch_shard_override epoch, its offsets are measured
+    # against the override stripe and the whole fleet must take the
+    # journal-derived resume path (a mixed schedule would be inconsistent)
+    ov = multihost_utils.process_allgather(
+        np.asarray(
+            -1
+            if snap.get("override_epoch") is None
+            else int(snap["override_epoch"]),
+            np.int64,
+        )
+    )
     out = {
         "per_process": gathered.tolist(),
         "batches": snap["batches"],
@@ -412,6 +431,8 @@ def _gather_data_cursor(snap: dict | None) -> dict | None:
     # never restore on a pod (and would mis-resume on the worker path)
     if snap.get("native_threads") is not None:
         out["native_threads"] = snap["native_threads"]
+    if int(ov.max()) >= 0:
+        out["override_epoch"] = int(ov.max())
     return out
 
 
@@ -423,7 +444,7 @@ def _resize_shard_override(
     *,
     world: int,
     host: int,
-) -> tuple[list, dict]:
+) -> tuple[list, dict, dict]:
     """Resize-consistent resume (data/resize.py): reconstruct this process's
     shard assignment for the resume epoch from the journaled cursors.
 
@@ -434,6 +455,12 @@ def _resize_shard_override(
     disjoint, exhaustive assignment independently. Raises when no cursor
     exists for the step (pre-elastic checkpoint, journal disabled) — the
     caller falls back to plain epoch resume.
+
+    Returns ``(pairs, preconsumed, info)``: ``preconsumed`` is the merged
+    consumed-set snapshot the assignment subtracted, in
+    :meth:`~jumbo_mae_tpu_tpu.data.resize.ShardLedger.snapshot` shape —
+    the caller seeds it into the new generation's ledgers so the next
+    ``shard_cursor`` events stay cumulative across generations.
     """
     from jumbo_mae_tpu_tpu.obs.journal import read_merged_journal
 
@@ -460,6 +487,9 @@ def _resize_shard_override(
     )
     consumed = merged.get(start_epoch, set())
     pairs = resize_assignment(order, consumed, world_size=world, process_id=host)
+    preconsumed = {
+        "epochs": {str(e): sorted(v) for e, v in merged.items()}
+    }
     info = {
         "step": start_step,
         "epoch": start_epoch,
@@ -470,7 +500,67 @@ def _resize_shard_override(
         "shards_remaining": len(order) - len(consumed),
         "cursor_hosts": sorted(latest),
     }
-    return pairs, info
+    return pairs, preconsumed, info
+
+
+def _apply_override_resume(
+    cfg: TrainConfig,
+    run_dir: Path,
+    data_cursor: dict | None,
+    start_step: int,
+    *,
+    process_count: int,
+    host_index: int,
+    emit,
+) -> tuple[dict | None, list | None, dict | None]:
+    """Decide the data-resume mode: sample-exact cursor vs journal-derived
+    shard override. The override path is taken when the cursor was saved
+    under a DIFFERENT world size (its per-worker offsets describe streams
+    striped for the old topology), or when it carries ``override_epoch`` —
+    the saving generation was itself running on an ``epoch_shard_override``,
+    so the offsets were measured on the override stripe and replaying them
+    against the topology stripe would silently yield different samples even
+    at the SAME world size (crash/preemption restart mid-override).
+
+    Returns ``(data_cursor, shard_override, shard_preconsumed)``. On the
+    override path the sample cursor is voided (resume is shard-granular);
+    when the journal cannot reconstruct the assignment, the cursor is also
+    voided — its offsets are meaningless for this generation's stripes —
+    and the run falls back to plain epoch resume.
+    """
+    if (
+        data_cursor is None
+        or cfg.run.synthetic_data
+        or not cfg.data.train_shards
+    ):
+        return data_cursor, None, None
+    old_world = int(data_cursor.get("process_count", 1))
+    if old_world == process_count and data_cursor.get("override_epoch") is None:
+        return data_cursor, None, None
+    try:
+        pairs, preconsumed, rinfo = _resize_shard_override(
+            cfg,
+            run_dir,
+            start_step,
+            old_world,
+            world=process_count,
+            host=host_index,
+        )
+    except Exception as e:  # noqa: BLE001 - epoch resume still works
+        print(
+            f"[train] WARNING: resize-consistent resume unavailable "
+            f"({e}); falling back to epoch resume"
+        )
+        return None, None, None
+    cause = "resize" if old_world != process_count else "override_restart"
+    emit("elastic_resize", cause=cause, **rinfo)
+    print(
+        f"[train] elastic resize ({cause}): world {old_world} -> "
+        f"{process_count}; epoch {rinfo['epoch']} resumes with "
+        f"{rinfo['shards_remaining']}/{rinfo['shards_total']} "
+        "shards unconsumed"
+    )
+    return None, pairs, preconsumed
 
 
 def evaluate(eval_step, state, batches, pad_batch: dict | None = None) -> dict[str, float]:
@@ -960,46 +1050,26 @@ def train(cfg: TrainConfig) -> dict:
             else contextlib.nullcontext()
         )
 
-    # resize-consistent resume: a checkpoint saved under a different
-    # world size voids the sample-exact cursor, but the journaled shard
-    # cursors reconstruct a shard-exact assignment for the new topology
-    # (no shard double-counted, none skipped — tests/test_elastic.py)
-    shard_override = None
-    if (
-        data_cursor is not None
-        and int(data_cursor.get("process_count", 1)) != process_count
-        and not run.synthetic_data
-        and cfg.data.train_shards
-    ):
-        old_world = int(data_cursor.get("process_count", 1))
-        try:
-            shard_override, rinfo = _resize_shard_override(
-                cfg,
-                run_dir,
-                start_step,
-                old_world,
-                world=process_count,
-                host=host_index,
-            )
-        except Exception as e:  # noqa: BLE001 - epoch resume still works
-            print(
-                f"[train] WARNING: resize-consistent resume unavailable "
-                f"({e}); falling back to epoch resume"
-            )
-        else:
-            data_cursor = None  # topology changed: the sample cursor is void
-            _emit("elastic_resize", **rinfo)
-            print(
-                f"[train] elastic resize: world {old_world} -> "
-                f"{process_count}; epoch {rinfo['epoch']} resumes with "
-                f"{rinfo['shards_remaining']}/{rinfo['shards_total']} "
-                "shards unconsumed"
-            )
+    # resize-consistent resume: a checkpoint saved under a different world
+    # size — or mid-override at the SAME world size — voids the sample-exact
+    # cursor, but the journaled shard cursors reconstruct a shard-exact
+    # assignment for this topology (no shard double-counted, none skipped —
+    # tests/test_elastic.py)
+    data_cursor, shard_override, shard_preconsumed = _apply_override_resume(
+        cfg,
+        run_dir,
+        data_cursor,
+        start_step,
+        process_count=process_count,
+        host_index=host_index,
+        emit=_emit,
+    )
 
     train_iter, source, cursor_log, shard_log = make_train_iterator(
         cfg, mesh, per_process, start_step, data_cursor,
         num_labels=enc_cfg.labels or 1000,
         shard_override=shard_override,
+        shard_preconsumed=shard_preconsumed,
     )
     meter = AverageMeter()
     timer = StepTimer(warmup_steps=min(2, max(1, run.training_steps - 1)))
@@ -1461,11 +1531,25 @@ def train(cfg: TrainConfig) -> dict:
         prev_window_bad = False  # restored stream starts clean
         if source is not None:
             source.close()
+        # a rollback checkpoint saved mid-override carries the same
+        # override_epoch marker a crash restart would see — re-derive
+        # the stripe from the journal instead of replaying its offsets
+        rb_cursor, rb_override, rb_preconsumed = _apply_override_resume(
+            cfg,
+            run_dir,
+            extra.get("data_cursor"),
+            new_step,
+            process_count=process_count,
+            host_index=host_index,
+            emit=_emit,
+        )
         with _hw_expected("rollback-restart"):
             train_iter, source, cursor_log, shard_log = make_train_iterator(
                 cfg, mesh, per_process, new_step,
-                extra.get("data_cursor"),
+                rb_cursor,
                 num_labels=enc_cfg.labels or 1000,
+                shard_override=rb_override,
+                shard_preconsumed=rb_preconsumed,
             )
         return new_step
 
@@ -1692,11 +1776,19 @@ def _run_elastic(args) -> int:
     cfg = load_config(args.config, args.overrides)
     run = cfg.run
     world = int(args.elastic)
-    if run.train_batch_size % world:
+    accum = max(1, run.grad_accum)
+
+    def _world_ok(w: int) -> bool:
+        # the child's own top-of-train validation: world * grad_accum must
+        # divide the global batch size. The supervisor clamps any downsized
+        # world through this, so a 4->3 resize can never relaunch children
+        # that all die on the same config error until the budget is gone.
+        return run.train_batch_size % (w * accum) == 0
+
+    if not _world_ok(world):
         raise ValueError(
-            f"--elastic {world} must divide run.train_batch_size "
-            f"({run.train_batch_size}) — and so must every DOWNSIZED world "
-            "the supervisor may relaunch at"
+            f"--elastic {world} (x grad_accum {accum}) must divide "
+            f"run.train_batch_size ({run.train_batch_size})"
         )
     run_dir = Path(run.output_dir) / run.name
     run_dir.mkdir(parents=True, exist_ok=True)
@@ -1754,6 +1846,7 @@ def _run_elastic(args) -> int:
         backoff_cap_s=run.elastic_backoff_cap_s,
         rejoin_after_s=run.elastic_rejoin_after_s,
         wedge_after_s=run.elastic_wedge_after_s,
+        world_ok=_world_ok,
         journal=journal,
     )
     import signal

@@ -41,7 +41,7 @@ def _run(cfg_json: str) -> None:
     cfg = DataConfig(**spec["data"])
     start_epoch = spec.get("start_epoch", 0)
     cursor = StreamCursor(start_epoch, spec.get("skip_samples", 0))
-    ledger = ShardLedger()
+    ledger = ShardLedger(preconsumed=spec.get("shard_preconsumed"))
     override = spec.get("epoch_shard_override")
     stream = train_sample_stream(
         cfg,

@@ -552,6 +552,7 @@ class TrainLoader:
         start_epoch: int = 0,
         cursor: dict | None = None,
         epoch_shard_override: list | None = None,
+        shard_preconsumed: dict | None = None,
     ):
         if batch_size % max(1, cfg.repeats):
             raise ValueError(
@@ -562,6 +563,11 @@ class TrainLoader:
         self.batch_size = batch_size
         self._workers: list[_Worker] = []
         self._shard_states: list = []
+        # epoch the active epoch_shard_override applies to — stamped into
+        # snapshots while any stream is still inside it, so a same-world
+        # restart knows the sample cursor was measured on the override
+        # stripe (not the topology stripe) and must re-derive it
+        self._override_epoch: int | None = None
         # loader telemetry (obs/metrics.py): how long the train loop waits
         # for batches, and whether workers are stalling or dying under it
         reg = get_registry()
@@ -648,10 +654,12 @@ class TrainLoader:
             self.batches_yielded = 0
         self._cursors = list(starts)
         self._shard_states = [None] * n_streams
+        if epoch_shard_override is not None:
+            self._override_epoch = min(e for e, _ in starts)
         if cfg.workers <= 0:
             from jumbo_mae_tpu_tpu.data.resize import ShardLedger
 
-            led = ShardLedger()
+            led = ShardLedger(preconsumed=shard_preconsumed)
             track = StreamCursor(*starts[0])
             self._stream = train_sample_stream(
                 cfg,
@@ -689,12 +697,22 @@ class TrainLoader:
                     [int(g), str(u)]
                     for g, u in epoch_shard_override[w :: cfg.workers]
                 ]
+            if shard_preconsumed is not None:
+                spec["shard_preconsumed"] = shard_preconsumed
             self._workers.append(_Worker(spec, per_worker_q))
 
     def snapshot(self) -> dict | None:
         """Resume cursor as of the last batch returned by ``__next__``.
         Native-IO snapshots also record the reader thread count — the
-        deterministic merge order depends on it, so resume validates it."""
+        deterministic merge order depends on it, so resume validates it.
+        While any stream is still inside an active ``epoch_shard_override``
+        epoch, the snapshot carries ``override_epoch``: its offsets were
+        measured against the override stripe, so a restart — even at the
+        SAME world size — must re-derive the override from the journaled
+        shard cursors instead of replaying the offsets on the topology
+        stripe. Once every stream has crossed into a later (normally
+        striped) epoch, the marker drops off and sample-exact resume is
+        valid again."""
         if not self._cursors:
             return None
         snap = {
@@ -703,6 +721,10 @@ class TrainLoader:
         }
         if getattr(self, "_native_threads", None) is not None:
             snap["native_threads"] = self._native_threads
+        if self._override_epoch is not None and any(
+            e <= self._override_epoch for e, _ in self._cursors
+        ):
+            snap["override_epoch"] = self._override_epoch
         return snap
 
     def shard_snapshot(self) -> dict | None:

@@ -50,14 +50,29 @@ class ShardLedger:
 
     Thread-compat: each stream owns its private ledger (one per
     (process, worker) pair); no locking needed.
+
+    ``preconsumed`` seeds the ledger with shards consumed by EARLIER
+    generations (the merged set a resized resume subtracted when it built
+    the stream's ``epoch_shard_override``). Without the seed, a fresh
+    generation's ``shard_cursor`` snapshots would record only its own
+    consumption, and the NEXT resize would re-assign everything consumed
+    before the first one — snapshots must stay cumulative across
+    generations for the conservation invariant to survive repeated
+    world-size changes. Accepts the :meth:`snapshot` shape
+    (``{"epochs": {str(epoch): [gidx, ...]}}``) or a bare
+    ``{epoch: indices}`` mapping.
     """
 
-    def __init__(self):
+    def __init__(self, preconsumed: dict | None = None):
         self._reads: dict[tuple[int, int], int] = {}
         self._yields: dict[tuple[int, int], int] = {}
         self._read_done: set[tuple[int, int]] = set()
         #: epoch -> sorted list of fully-consumed global shard indices
         self.consumed: dict[int, list[int]] = {}
+        if preconsumed:
+            epochs = preconsumed.get("epochs", preconsumed)
+            for e, idxs in epochs.items():
+                self.consumed[int(e)] = sorted(int(i) for i in idxs)
 
     def note_read(self, epoch: int, gidx: int) -> None:
         k = (epoch, gidx)
@@ -76,8 +91,10 @@ class ShardLedger:
     def _maybe_promote(self, k: tuple[int, int]) -> None:
         if k in self._read_done and self._yields.get(k, 0) >= self._reads.get(k, 0):
             epoch, gidx = k
-            self.consumed.setdefault(epoch, []).append(gidx)
-            self.consumed[epoch].sort()
+            lst = self.consumed.setdefault(epoch, [])
+            if gidx not in lst:
+                lst.append(gidx)
+                lst.sort()
             # retire the counters — the shard is settled
             self._read_done.discard(k)
             self._reads.pop(k, None)

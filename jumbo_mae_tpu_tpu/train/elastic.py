@@ -11,8 +11,9 @@ makes host loss a survivable, journaled, budgeted event:
   restart: survivors are drained (SIGTERM → grace → SIGKILL — a process
   blocked in a dead collective cannot run its preemption checkpoint, the
   last *committed* checkpoint is the resume point), world size is
-  recomputed without the failed slots, and the fleet relaunches from the
-  last committed checkpoint;
+  recomputed without the bad slots (for ``EXIT_ELASTIC`` the exiting
+  children are the healthy detectors, so the fleet restarts at their
+  count), and the fleet relaunches from the last committed checkpoint;
 - restarts are budgeted: ``max_restarts`` with exponential backoff
   (``backoff_s`` doubling to ``backoff_cap_s``); exhaustion journals
   ``elastic_exhausted`` with a verdict and exits nonzero;
@@ -51,9 +52,11 @@ from jumbo_mae_tpu_tpu.train.engine import (
     EXIT_HANG,
 )
 
-#: teardown reasons that remove the failed slots from the next world size
-#: (the "machine" is presumed bad until the rejoin timer says otherwise)
-_DOWNSIZE_REASONS = frozenset({"host_dead", "hang", "host_lost", "wedged"})
+#: teardown reasons where the FAILED slots are the bad machines, removed
+#: from the next world size (presumed bad until the rejoin timer says
+#: otherwise). ``host_lost`` is handled separately: there the exiting
+#: children are the healthy DETECTORS and the next world is their count.
+_DOWNSIZE_REASONS = frozenset({"host_dead", "hang", "wedged"})
 
 
 class ElasticSupervisor:
@@ -80,6 +83,7 @@ class ElasticSupervisor:
         wedge_after_s: float = 0.0,
         grace_s: float = 15.0,
         poll_s: float = 0.2,
+        world_ok: Callable[[int], bool] | None = None,
         journal=None,
         clock: Callable[[], float] = time.monotonic,
         sleep_fn: Callable[[float], None] = time.sleep,
@@ -94,6 +98,12 @@ class ElasticSupervisor:
         self.wedge_after_s = float(wedge_after_s)
         self.grace_s = float(grace_s)
         self.poll_s = float(poll_s)
+        #: optional divisibility predicate for candidate world sizes (e.g.
+        #: "divides run.train_batch_size"). A downsize is clamped to the
+        #: largest valid world at or below the candidate — relaunching at
+        #: an invalid world would crash every child with a config error and
+        #: burn the whole restart budget re-proving it.
+        self.world_ok = world_ok
         self.journal = journal
         self._clock = clock
         self._sleep = sleep_fn
@@ -208,10 +218,10 @@ class ElasticSupervisor:
             return "hang", hang
         lost = [i for i, c in dead.items() if c == EXIT_ELASTIC]
         if lost:
-            # the exiting children are the *detectors*; the lost peer is
-            # whichever slot did NOT exit EXIT_ELASTIC — but from exit
-            # codes alone the detector set is what we know, so restart at
-            # the same world minus nothing and let beacons disambiguate.
+            # the exiting children are the healthy *detectors*; the lost
+            # peers are the slots that did NOT exit EXIT_ELASTIC. The run
+            # loop restarts at the detector count (the surviving hosts),
+            # not world minus the detectors.
             return "host_lost", lost
         return "crash", list(dead)
 
@@ -306,11 +316,28 @@ class ElasticSupervisor:
                 return EXIT_FATAL
             self.restarts_used += 1
             new_world = world
-            if reason in _DOWNSIZE_REASONS:
+            if reason == "host_lost":
+                # the EXIT_ELASTIC children are the healthy detectors that
+                # saw a peer's beacon go stale — the lost hosts are the
+                # slots that did NOT exit, so the surviving world is the
+                # detector count (world - len(failed) would idle healthy
+                # hosts until rejoin)
+                new_world = max(1, len(failed))
+            elif reason in _DOWNSIZE_REASONS:
                 new_world = max(1, world - len(failed))
-            self._sleep(backoff)
+            requested = new_world
+            if new_world < world and self.world_ok is not None:
+                while new_world > 1 and not self.world_ok(new_world):
+                    new_world -= 1
+            slept = backoff
+            self._sleep(slept)
             backoff = min(self.backoff_cap_s, backoff * 2)
             self.generation += 1
+            extra = (
+                {"requested_world": requested}
+                if new_world != requested
+                else {}
+            )
             self._emit(
                 "elastic_restart",
                 reason=reason,
@@ -320,7 +347,10 @@ class ElasticSupervisor:
                 new_world=new_world,
                 generation=self.generation,
                 restarts_used=self.restarts_used,
-                backoff_s=round(backoff, 3),
+                # the delay actually slept before THIS relaunch (the
+                # doubled value applies to the next restart)
+                backoff_s=round(slept, 3),
+                **extra,
             )
             self._m_restarts.labels(reason).inc()
             if new_world < world:

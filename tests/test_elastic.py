@@ -397,6 +397,111 @@ class TestShardLedger:
         merged = merge_shard_states([snap, b.snapshot(), None, {}])
         assert merged == {0: {1, 2}, 1: {0}}
 
+    def test_preconsumed_seed_makes_snapshots_cumulative(self):
+        # a resized resume seeds the new generation's ledger with the
+        # merged set it subtracted — snapshots must cover BOTH
+        led = ShardLedger(preconsumed={"epochs": {"0": [3, 1], "2": [0]}})
+        assert led.snapshot() == {"epochs": {"0": [1, 3], "2": [0]}}
+        led.note_read_done(0, 5)
+        assert led.snapshot()["epochs"]["0"] == [1, 3, 5]
+        # re-promoting a seeded shard must not duplicate it
+        led.note_read_done(0, 3)
+        assert led.snapshot()["epochs"]["0"] == [1, 3, 5]
+
+    def test_second_resize_conserves_with_seeded_ledger(self):
+        # the double-resize regime: gen0 (world 2) consumes, resize to
+        # world 1 with a SEEDED ledger, gen1 consumes more, resize to
+        # world 2 off gen1's shard_cursor alone — conservation must hold
+        # because gen1's snapshots are cumulative across generations.
+        order = _order(n=12)
+        gen0 = merge_shard_states(
+            [{"epochs": {"0": [0, 2]}}, {"epochs": {"0": [1]}}]
+        )[0]
+        led = ShardLedger(preconsumed={"epochs": {"0": sorted(gen0)}})
+        pairs = resize_assignment(order, gen0, world_size=1, process_id=0)
+        for g, _ in pairs[:2]:  # gen1 consumes two shards of its stripe
+            led.note_read_done(0, g)
+        consumed = merge_shard_states([led.snapshot()])[0]
+        assert consumed == gen0 | {g for g, _ in pairs[:2]}
+        after = set()
+        for p in range(2):
+            after |= {
+                i
+                for i, _ in resize_assignment(
+                    order, consumed, world_size=2, process_id=p
+                )
+            }
+        assert consumed | after == set(range(12))
+        assert consumed & after == set()
+
+
+class TestLoaderOverrideResume:
+    """The loader-side contracts a mid-override restart depends on: the
+    snapshot carries ``override_epoch`` while any stream is inside the
+    override stripe (so a SAME-world restart re-derives the assignment
+    from the journal instead of replaying offsets against the topology
+    stripe), and ``shard_preconsumed`` seeds the ledger so shard cursors
+    are cumulative across generations."""
+
+    def _cfg(self, tmp_path):
+        from jumbo_mae_tpu_tpu.data import DataConfig
+        from jumbo_mae_tpu_tpu.data.toy import write_toy_shards
+
+        urls = write_toy_shards(
+            tmp_path / "toy", n_train=32, n_val=8, shard_size=8, image_size=16
+        )
+        return DataConfig(
+            train_shards=urls["train"],
+            image_size=16,
+            workers=0,
+            shuffle_buffer=4,
+            seed=7,
+        )
+
+    def test_marker_present_inside_override_epoch_then_drops(self, tmp_path):
+        from jumbo_mae_tpu_tpu.data import TrainLoader
+
+        cfg = self._cfg(tmp_path)
+        order = epoch_shard_order(cfg.train_shards, seed=cfg.seed, epoch=0)
+        consumed = {0}
+        override = resize_assignment(
+            order, consumed, world_size=1, process_id=0
+        )
+        loader = TrainLoader(
+            cfg,
+            batch_size=8,
+            epoch_shard_override=override,
+            shard_preconsumed={"epochs": {"0": sorted(consumed)}},
+        )
+        try:
+            next(loader)
+            snap = loader.snapshot()
+            # offsets were measured on the override stripe: marker present
+            assert snap["override_epoch"] == 0
+            # seeded ledger: gen0's consumed shard rides every cursor
+            shards = loader.shard_snapshot()
+            assert 0 in {int(i) for i in shards["epochs"]["0"]}
+            # override epoch has 3 shards x 8 samples = 24 samples; after
+            # batch 4 the stream is in epoch 1 (normal stripe) and the
+            # sample cursor is trustworthy again
+            for _ in range(2):
+                next(loader)
+            assert loader.snapshot()["override_epoch"] == 0
+            next(loader)
+            assert "override_epoch" not in loader.snapshot()
+        finally:
+            loader.close()
+
+    def test_plain_loader_has_no_marker(self, tmp_path):
+        from jumbo_mae_tpu_tpu.data import TrainLoader
+
+        loader = TrainLoader(self._cfg(tmp_path), batch_size=8)
+        try:
+            next(loader)
+            assert "override_epoch" not in loader.snapshot()
+        finally:
+            loader.close()
+
 
 # ------------------------------------------------- supervisor state machine
 
@@ -504,7 +609,59 @@ class TestSupervisorLoop:
         )
         sup.run()
         backoffs = [e["backoff_s"] for e in journal.of("elastic_restart")]
-        assert backoffs == [0.2, 0.4, 0.4, 0.4]  # journaled post-double, capped
+        # journaled value is the delay actually slept before each relaunch
+        assert backoffs == [0.1, 0.2, 0.4, 0.4]
+
+    def test_host_lost_downsizes_to_detector_count(self, tmp_path):
+        # world 3, one peer's beacon goes stale: the TWO healthy detectors
+        # exit EXIT_ELASTIC. The next world is the detector count (2), not
+        # world - len(detectors) = 1, which would idle a healthy host.
+        clock = FakeClock()
+        lost_peer = FakeProc(clock, pid=40)  # alive but its beacon is stale
+        fleets = [
+            lambda w, g: [
+                FakeProc(clock, dies_at=0.0, rc=EXIT_ELASTIC),
+                FakeProc(clock, dies_at=0.0, rc=EXIT_ELASTIC),
+                lost_peer,
+            ],
+            lambda w, g: [
+                FakeProc(clock, dies_at=clock() + 0.1, rc=0) for _ in range(w)
+            ],
+        ]
+        sup, journal = make_supervisor(
+            tmp_path, ScriptedLaunch(fleets), clock, world_size=3
+        )
+        assert sup.run() == 0
+        assert sup._launch.calls == [(3, 0), (2, 1)]
+        # the still-running lost peer was torn down with the generation
+        assert lost_peer.signals
+        (ev,) = journal.of("elastic_restart")
+        assert ev["reason"] == "host_lost"
+        assert (ev["old_world"], ev["new_world"]) == (3, 2)
+
+    def test_downsize_clamped_to_valid_world(self, tmp_path):
+        # batch size divisible by 4 and 2 but not 3: a 4->3 downsize must
+        # clamp to 2 instead of relaunching children that all die on the
+        # same config error until the budget is exhausted
+        clock = FakeClock()
+        fleets = [
+            lambda w, g: [FakeProc(clock, dies_at=0.0, rc=-9)]
+            + [FakeProc(clock) for _ in range(3)],
+            lambda w, g: [
+                FakeProc(clock, dies_at=clock() + 0.1, rc=0) for _ in range(w)
+            ],
+        ]
+        sup, journal = make_supervisor(
+            tmp_path,
+            ScriptedLaunch(fleets),
+            clock,
+            world_size=4,
+            world_ok=lambda w: 8 % w == 0,
+        )
+        assert sup.run() == 0
+        assert sup._launch.calls == [(4, 0), (2, 1)]
+        (ev,) = journal.of("elastic_restart")
+        assert ev["new_world"] == 2 and ev["requested_world"] == 3
 
     def test_rejoin_after_timer(self, tmp_path):
         clock = FakeClock()
