@@ -78,6 +78,7 @@ from jumbo_mae_tpu_tpu.train import (
 from jumbo_mae_tpu_tpu.obs import (
     FleetAggregator,
     FlightRecorder,
+    GoodputLedger,
     HangWatchdog,
     HealthState,
     HostBeacon,
@@ -624,6 +625,14 @@ def train(cfg: TrainConfig) -> dict:
     # pin the fault layer's host identity (the `@host=` selector) before any
     # site can fire; mirrored into GRAFT_HOST so data workers inherit it
     set_host_index(host_index)
+    # elastic generation: stamped into the environment by the supervisor's
+    # launch() so scrapes, beacons and merged journals can tell pre- from
+    # post-restart processes (0 = first launch / no supervisor)
+    generation = int(os.environ.get("GRAFT_GENERATION", "0") or 0)
+    # goodput ledger (obs/goodput.py): the clock starts HERE, at the top of
+    # train(), so state build, compile and restore are on the books — every
+    # second of this process's wall-clock lands in exactly one bucket
+    ledger = GoodputLedger(generation=generation)
     if run.train_batch_size % (process_count * run.grad_accum):
         raise ValueError(
             f"process_count * grad_accum ({process_count} * {run.grad_accum}) "
@@ -806,6 +815,8 @@ def train(cfg: TrainConfig) -> dict:
             )
         start_step = int(state.step)
         data_cursor = extra.get("data_cursor")
+        if not run.eval_only:
+            ledger.add("ckpt_restore", ckpt.last_restore_s or 0.0)
         print(f"[train] resumed from step {start_step}")
 
     mode_key = "pretrain" if run.mode == "pretrain" else "classify"
@@ -969,6 +980,7 @@ def train(cfg: TrainConfig) -> dict:
         env=env_fingerprint(),
         start_step=start_step,
         resumed=bool(resuming),
+        generation=generation,
         diag_every=run.diag_every,
         diag_groups=list(diag_names),
     )
@@ -981,7 +993,7 @@ def train(cfg: TrainConfig) -> dict:
     # lost/rejoined transitions via _emit, and feeds /healthz (soft degraded)
     beacon = None
     fleet_agg = None
-    beacon_stats: dict = {}
+    beacon_stats: dict = {"generation": generation}
     if run.fleet:
         beacon = HostBeacon(run_dir / "fleet", host=host_index)
         if is_main:
@@ -1022,6 +1034,15 @@ def train(cfg: TrainConfig) -> dict:
         @hangwatch.on_fire
         def _hang_fired(info):
             _emit("hang_detected", host=host_index, **info)
+            # the stall the watchdog sat through is pure detection latency;
+            # a final cumulative report makes it to the journal before the
+            # os._exit — offline stitching reads it as this generation's
+            # last word
+            ledger.add("hang_latency", float(info.get("stalled_s") or 0.0))
+            _emit(
+                "goodput_report",
+                **ledger.report(step=int(info.get("step") or 0), reason="hang"),
+            )
             _beacon_write(int(info.get("step") or 0))
             if flightrec is not None:
                 try:
@@ -1118,6 +1139,11 @@ def train(cfg: TrainConfig) -> dict:
         "train_hardware_flops_utilization",
         "XLA-counted flops (remat recompute included) / peak (log-window)",
     )
+    g_gen = reg.gauge(
+        "run_generation",
+        "elastic supervisor generation of this process (0 = first launch)",
+    )
+    g_gen.set(generation)
     # compiled-cost observability: the AOT dispatch in train/steps exposes
     # the step's executable, so XLA's cost/memory analysis is a free readout
     # — no second compile. Extracted once at the first log boundary,
@@ -1173,6 +1199,7 @@ def train(cfg: TrainConfig) -> dict:
         with sp_wait:
             batch = next(train_iter)
         window_wait += sp_wait.last_s
+        ledger.add("data_wait", sp_wait.last_s)
         health.beat("data_batch")
         return batch
 
@@ -1206,6 +1233,9 @@ def train(cfg: TrainConfig) -> dict:
                     state_now, metrics = train_step(state_now, batch, inject)
             else:
                 state_now, metrics = train_step(state_now, batch, inject)
+        # dispatch span → productive / compile (first dispatch) / rollback
+        # recompute; the ledger routes by step number and process history
+        ledger.note_step(step_now, sp_step.last_s)
         return state_now, metrics
 
     engine = RunEngine(
@@ -1213,6 +1243,7 @@ def train(cfg: TrainConfig) -> dict:
         start_step=start_step,
         log_interval=run.log_interval,
         eval_interval=run.eval_interval,
+        ckpt_interval=run.ckpt_every,
         process_count=process_count,
         next_batch=_next_batch,
         dispatch=_dispatch,
@@ -1375,6 +1406,7 @@ def train(cfg: TrainConfig) -> dict:
         now = time.perf_counter()
         wait_frac = window_wait / max(now - window_t0, 1e-9)
         g_wait_frac.set(wait_frac)
+        ledger.publish()  # goodput_* gauges follow the log-window cadence
         # memory sample BEFORE the beacon write so this window's
         # rss/device-peak ride out in this window's beacon
         msnap = None
@@ -1412,6 +1444,7 @@ def train(cfg: TrainConfig) -> dict:
                 ),
                 shard_quarantines=len(QUARANTINE.snapshot()),
                 sentinel_bad_steps=bad_total,
+                goodput_fraction=round(ledger.fraction(), 4),
             )
             _beacon_write(step)
             if fleet_agg is not None:
@@ -1503,12 +1536,17 @@ def train(cfg: TrainConfig) -> dict:
                 "set run.eval_interval below the failure point"
             )
         sentinel.record_rollback()  # raises once budget is spent
+        t0_restore = time.perf_counter()
         with _hw_expected("rollback"):
             ckpt.wait()  # a save may still be in flight
             eng.state, extra = ckpt.restore(
                 eng.state, sharding=state_sharding
             )
+        ledger.add("ckpt_restore", time.perf_counter() - t0_restore)
         rolled_from, new_step = step, int(eng.state.step)
+        # every step re-dispatched up to rolled_from is recompute, not
+        # progress — lost work the goodput report makes visible
+        ledger.note_rollback(rolled_from, new_step)
         print(
             f"[train] sentinel rollback #{sentinel.rollbacks} → "
             f"resuming from step {new_step}"
@@ -1558,6 +1596,7 @@ def train(cfg: TrainConfig) -> dict:
         nonlocal last_metrics
         if valid_factory is None:
             return None
+        t0_eval = time.perf_counter()
         with _hw_expected("eval"):
             if retrace_sentinel is not None:
                 with retrace_sentinel.expected("eval"):
@@ -1566,6 +1605,7 @@ def train(cfg: TrainConfig) -> dict:
                     )
             else:
                 val = evaluate(eval_step, state_now, valid_factory(), pad_batch)
+        ledger.add("eval", time.perf_counter() - t0_eval)
         logger.log(val, step=step)
         last_metrics |= val
         return val
@@ -1590,6 +1630,7 @@ def train(cfg: TrainConfig) -> dict:
                     eng.state,
                     extra={"data_cursor": snap} if snap is not None else None,
                 )
+            ledger.add("ckpt_save", sp_ckpt.last_s)
             _emit("checkpoint_save", step=step, preemption=True)
             _emit_shard_cursor(step)
             return
@@ -1600,12 +1641,17 @@ def train(cfg: TrainConfig) -> dict:
         with _hw_expected("checkpoint"), sp_ckpt:
             ckpt.save(step, eng.state, metrics=cev.metrics, extra=extra)
         cev.save_seconds = round(sp_ckpt.last_s, 3)
+        ledger.add("ckpt_save", sp_ckpt.last_s)
         _emit(
             "checkpoint_save",
             step=step,
             eval_metrics=cev.metrics,
             save_seconds=cev.save_seconds,
         )
+        # periodic cumulative attribution snapshot, one per committed
+        # checkpoint — the offline stitcher keys lost work off these
+        ledger.publish()
+        _emit("goodput_report", **ledger.report(step=step))
         _emit_shard_cursor(step)
         for k in [k for k in shard_log if k <= step]:
             del shard_log[k]
@@ -1661,6 +1707,10 @@ def train(cfg: TrainConfig) -> dict:
 
     @engine.on_shutdown
     def _journal_shutdown(eng, reason, step):
+        # final authoritative ledger word: covers the tail past the last
+        # checkpoint and carries the exit reason
+        ledger.publish()
+        _emit("goodput_report", **ledger.report(step=step, reason=reason))
         _emit("shutdown", reason=reason, step=step)
         _beacon_write(step)  # final heartbeat: a clean exit is not a lost host
         if flightrec is not None:
@@ -1813,6 +1863,11 @@ def _run_elastic(args) -> int:
 
     def launch(world_size: int, gen: int) -> list:
         port = _free_port()
+        # children learn their generation from the environment (it is not
+        # a config field): beacons, run_start events and the run_generation
+        # gauge all stamp it, so merged journals distinguish pre- and
+        # post-restart processes
+        env = dict(os.environ, GRAFT_GENERATION=str(gen))
         procs = []
         for i in range(world_size):
             procs.append(
@@ -1828,7 +1883,8 @@ def _run_elastic(args) -> int:
                         str(world_size),
                         "--process-id",
                         str(i),
-                    ]
+                    ],
+                    env=env,
                 )
             )
         print(

@@ -67,6 +67,11 @@ class RunConfig:
     training_steps: int = 100
     log_interval: int = 50
     eval_interval: int = 1000
+    # checkpoint cadence decoupled from eval: ckpt_every > 0 also saves a
+    # checkpoint every N steps (no eval pass attached). 0 keeps the legacy
+    # behavior — checkpoints ride eval boundaries only. tools/goodput_doctor
+    # recommends a concrete value from measured save cost and failure rate.
+    ckpt_every: int = 0
 
     train_batch_size: int = 256  # GLOBAL batch
     valid_batch_size: int = 256

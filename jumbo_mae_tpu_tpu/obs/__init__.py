@@ -34,6 +34,9 @@
 - ``obs.lockwatch`` — opt-in instrumented locks (``GRAFT_LOCKWATCH=1``):
   runtime lock-order inversion + long-hold detection, ``lock_*`` metrics,
   ``lock_order_violation`` journal events.
+- ``obs.goodput``  — goodput accounting: wall-clock attribution ledger
+  (``goodput_*`` gauges, ``goodput_report`` journal events), cross-
+  generation journal stitching, and the checkpoint-interval advisor.
 - ``obs.hangwatch`` — step-deadline hang watchdog: converts a wedged
   collective into a fast ``EXIT_HANG`` death the elastic supervisor can
   restart (``hang_detected`` journal event, bounded checkpoint drain).
@@ -52,6 +55,13 @@ modules remain as import-compatible shims over this package.
 from jumbo_mae_tpu_tpu.obs.exporter import HealthState, TelemetryServer
 from jumbo_mae_tpu_tpu.obs.fleet import FleetAggregator, HostBeacon, read_beacons
 from jumbo_mae_tpu_tpu.obs.flightrec import FlightRecorder
+from jumbo_mae_tpu_tpu.obs.goodput import (
+    GOODPUT_BUCKETS,
+    GoodputLedger,
+    advise_ckpt_interval,
+    bucket_display,
+    stitch_generations,
+)
 from jumbo_mae_tpu_tpu.obs.hangwatch import HangWatchdog
 from jumbo_mae_tpu_tpu.obs.journal import (
     JOURNAL_EVENTS,
@@ -159,7 +169,9 @@ __all__ = [
     "Family",
     "FleetAggregator",
     "FlightRecorder",
+    "GOODPUT_BUCKETS",
     "Gauge",
+    "GoodputLedger",
     "HangWatchdog",
     "HostBeacon",
     "HealthState",
@@ -189,8 +201,10 @@ __all__ = [
     "STAT_NAMES",
     "TelemetryServer",
     "UtilizationReport",
+    "advise_ckpt_interval",
     "annotate",
     "append_row",
+    "bucket_display",
     "chip_spec",
     "classify_flops_per_image",
     "comparable_env",
@@ -232,6 +246,7 @@ __all__ = [
     "span_timer",
     "start_chrome_trace",
     "stats_dict",
+    "stitch_generations",
     "stop_chrome_trace",
     "trace",
     "tree_nbytes",

@@ -100,6 +100,16 @@ _BEACON_GAUGES = (
         "fleet_device_peak_bytes",
         "per-host high-water device (HBM) bytes from its beacon",
     ),
+    (
+        "goodput_fraction",
+        "fleet_goodput_fraction",
+        "per-host share of wall-clock in productive step compute",
+    ),
+    (
+        "generation",
+        "fleet_generation",
+        "elastic generation this host's process was launched in",
+    ),
 )
 
 
@@ -272,6 +282,10 @@ class FleetAggregator:
             labels=("host",),
         )
         self._g_alive = reg.gauge("fleet_hosts_alive", "hosts with a fresh heartbeat")
+        self._g_goodput = reg.gauge(
+            "fleet_goodput",
+            "fleet goodput: mean productive wall-clock share across live hosts",
+        )
         self._g_expected = reg.gauge(
             "fleet_hosts_expected", "process count this run was launched with"
         )
@@ -429,6 +443,19 @@ class FleetAggregator:
             self._g_mem_outlier.labels(host=hs).set(1 if mem_outlier else 0)
 
         self._g_alive.set(len(alive))
+        # fleet goodput: lockstep collectives equalize productive time, so
+        # the mean over live hosts IS the fleet figure (a wedged host drags
+        # every ledger down with it)
+        goodputs = [
+            float(b["goodput_fraction"])
+            for b in alive.values()
+            if b.get("goodput_fraction") is not None
+        ]
+        fleet_goodput = (
+            round(sum(goodputs) / len(goodputs), 4) if goodputs else None
+        )
+        if fleet_goodput is not None:
+            self._g_goodput.set(fleet_goodput)
         if self.expected_hosts is not None:
             self._g_expected.set(self.expected_hosts)
         missing = (
@@ -445,6 +472,7 @@ class FleetAggregator:
             "stragglers": [h for h, s in hosts.items() if s["status"] == self.STRAGGLER],
             "lost": [h for h, s in hosts.items() if s["status"] == self.LOST],
             "mem_outliers": [h for h, s in hosts.items() if s["mem_outlier"]],
+            "goodput_fraction": fleet_goodput,
         }
         summary["degraded"] = bool(summary["stragglers"] or summary["lost"])
         self._summary = summary

@@ -99,6 +99,10 @@ JOURNAL_EVENTS = frozenset(
         "elastic_exhausted",
         "ckpt_fallback",
         "shard_cursor",
+        # goodput accounting (obs/goodput.py): cumulative wall-clock
+        # attribution snapshots, journaled at checkpoint boundaries, on
+        # hang detection, and at shutdown
+        "goodput_report",
     }
 )
 

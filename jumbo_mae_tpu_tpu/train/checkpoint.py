@@ -28,6 +28,7 @@ import atexit
 import json
 import os
 import threading
+import time
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Any
@@ -181,6 +182,11 @@ class Checkpointer:
             ),
         )
         self._best_metric = self._read_best_metric()
+        # measured wall-clock of the most recent save/restore (synchronous
+        # portion) — the goodput ledger charges these to its checkpoint
+        # buckets, and the interval advisor reads the save cost.
+        self.last_save_s: float | None = None
+        self.last_restore_s: float | None = None
 
     def _read_best_metric(self) -> float | None:
         step = self._best.latest_step()
@@ -218,6 +224,7 @@ class Checkpointer:
         # pod-scale failure — injectable without a real flaky filesystem
         from jumbo_mae_tpu_tpu.faults.inject import fault_point
 
+        t0 = time.perf_counter()
         fault_point("ckpt.save", key=str(step))
         extra = dict(extra or {})
         state, was_typed = split_rng_for_save(state)
@@ -239,6 +246,7 @@ class Checkpointer:
                     extra=ocp.args.JsonSave(best_extra),
                 ),
             )
+        self.last_save_s = time.perf_counter() - t0
         return is_best
 
     def latest_step(self, which: str = "last") -> int | None:
@@ -279,6 +287,7 @@ class Checkpointer:
         bounded — a store where every step is bad still raises. The
         ``ckpt.load`` fault site fires per attempt with the step as key.
         """
+        t0 = time.perf_counter()
         mgr, step = self._resolve(which, step)
         tmpl, _ = split_rng_for_save(template)
         abstract = abstract_state(tmpl, sharding)
@@ -315,6 +324,7 @@ class Checkpointer:
                 continue
             extra = out["extra"] or {}
             state = rejoin_rng(out["state"], extra.get("_rng_typed", False))
+            self.last_restore_s = time.perf_counter() - t0
             return state, extra
         raise last_err  # pragma: no cover - loop always raises or returns
 

@@ -32,6 +32,14 @@ its own segment file (``RunJournal`` writers always open a fresh
 max+1-indexed segment), so ``read_merged_journal`` interleaves supervisor
 events (``role="supervisor"``) with the hosts' without coordination.
 
+The journaled generation boundaries (``elastic_restart`` with
+``generation``/``backoff_s``, plus the ``GRAFT_GENERATION`` the launch
+callback stamps into each child) are what ``obs/goodput.py``'s
+``stitch_generations`` prices offline: inter-generation gaps become
+hang-latency + restart-downtime buckets, and lost work is steps executed
+minus steps committed when each generation died (``tools/goodput_doctor``
+renders the per-restart cost table).
+
 Everything time-related is injectable (``clock``/``sleep_fn``) so the
 restart/backoff/rejoin state machine is unit-testable without subprocesses
 (the launch callback is just a factory returning ``Popen``-shaped

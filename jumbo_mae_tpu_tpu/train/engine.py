@@ -131,6 +131,7 @@ class RunEngine:
         start_step: int = 0,
         log_interval: int = 1,
         eval_interval: int = 0,
+        ckpt_interval: int = 0,
         process_count: int = 1,
         next_batch: Callable[[int], object],
         dispatch: Callable,
@@ -141,6 +142,7 @@ class RunEngine:
         self.start_step = int(start_step)
         self.log_interval = max(1, int(log_interval))
         self.eval_interval = int(eval_interval)
+        self.ckpt_interval = int(ckpt_interval)
         self.process_count = int(process_count)
         self._next_batch = next_batch
         self._dispatch = dispatch
@@ -243,6 +245,12 @@ class RunEngine:
             self.eval_interval > 0 and step % self.eval_interval == 0
         )
 
+    def at_ckpt_boundary(self, step: int) -> bool:
+        """Checkpoint-only cadence (``run.ckpt_every``), decoupled from
+        eval so the save interval can track failure rate, not eval cost.
+        0 keeps the legacy coupling: saves ride eval boundaries only."""
+        return self.ckpt_interval > 0 and step % self.ckpt_interval == 0
+
     # -- the driver ------------------------------------------------------
     def run(self, state):
         """Drive ``state`` from ``start_step`` to ``training_steps``.
@@ -294,12 +302,14 @@ class RunEngine:
                         continue
 
                 saved_this_step = False
-                if self.at_eval_boundary(step):
+                run_eval = self.at_eval_boundary(step)
+                if run_eval or self.at_ckpt_boundary(step):
                     evals: dict | None = None
-                    for fn in self._on_eval:
-                        r = fn(self, step, self.state)
-                        if r:
-                            evals = {**(evals or {}), **r}
+                    if run_eval:
+                        for fn in self._on_eval:
+                            r = fn(self, step, self.state)
+                            if r:
+                                evals = {**(evals or {}), **r}
                     cev = CheckpointEvent(step, evals, reason="interval")
                     for fn in self._on_checkpoint:
                         fn(self, cev)

@@ -271,16 +271,20 @@ GOLDEN_SEQUENCE = [
     ("step", 4),
     ("mem_sample", 4),
     ("checkpoint_save", 4),
+    ("goodput_report", 4),
     ("step", 6),
     ("mem_sample", 6),
     ("step", 8),
     ("mem_sample", 8),
     ("checkpoint_save", 8),
+    ("goodput_report", 8),
     ("step", 10),
     ("mem_sample", 10),
     ("step", 12),
     ("mem_sample", 12),
     ("checkpoint_save", 12),
+    ("goodput_report", 12),
+    ("goodput_report", 12),
     ("shutdown", 12),
 ]
 GOLDEN_FINAL = {"train/loss": 1.0147541761398315, "train/grad_norm": 0.3212621212005615}

@@ -1,0 +1,716 @@
+"""Goodput accounting suite (PR 20): every second of wall-clock lands in
+exactly one bucket, and the ledger can prove it.
+
+- ``GoodputLedger``: first-dispatch → compile, rollback watermark →
+  recompute, idle as the residual, and the conservation invariant —
+  attributed time may never exceed measured wall-clock (over-attribution
+  is the falsifiable failure mode the residual-idle construction leaves).
+- ``advise_ckpt_interval``: Young's √(2·save_cost·MTBF), the
+  no-failures-observed MTBF lower bound, and the clamps.
+- ``stitch_generations``: a killed-and-relaunched elastic run stitched
+  from per-generation journals — inter-generation downtime split into
+  hang-detection latency + restart downtime, lost steps = executed −
+  committed, conservation across the stitch.
+- ``tools/goodput_doctor.py``: exit codes, the attribution table, the
+  restart-cost breakdown naming restart downtime, and a concrete
+  ``run.ckpt_every`` recommendation.
+- ``tools/run_doctor.py`` timeline: renders the elastic lifecycle events
+  (restart/resize/rejoin, hang_detected, ckpt_fallback).
+- Conservation property tests on real in-process ``train()`` runs —
+  clean and under seeded fault plans (slow; the CI goodput chaos smoke
+  runs them).
+"""
+
+import json
+import math
+from pathlib import Path
+
+import pytest
+
+from jumbo_mae_tpu_tpu import faults
+from jumbo_mae_tpu_tpu.config import load_config
+from jumbo_mae_tpu_tpu.data.tario import QUARANTINE
+from jumbo_mae_tpu_tpu.obs.fleet import FleetAggregator, HostBeacon
+from jumbo_mae_tpu_tpu.obs.goodput import (
+    GOODPUT_BUCKETS,
+    GoodputLedger,
+    advise_ckpt_interval,
+    bucket_display,
+    stitch_generations,
+)
+from jumbo_mae_tpu_tpu.obs.journal import read_journal
+from jumbo_mae_tpu_tpu.obs.metrics import MetricsRegistry
+
+RECIPES = Path(__file__).resolve().parent.parent / "recipes"
+
+
+@pytest.fixture
+def fault_plan():
+    """Install-and-always-clear: plans are process-global by design."""
+    yield faults.install_plan
+    faults.clear_plan()
+    QUARANTINE.clear()
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def _ledger(clock, **kw):
+    return GoodputLedger(registry=MetricsRegistry(), clock=clock, **kw)
+
+
+def _gauge_value(reg, name, **labels):
+    fam = reg._families[name]
+    return fam._children[tuple(str(v) for v in labels.values())].value
+
+
+# ------------------------------------------------------------------ ledger
+
+
+class TestGoodputLedger:
+    def test_first_dispatch_is_compile_not_productive(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        clock.advance(5.0)
+        led.note_step(1, 4.0)  # trace+compile rides the first dispatch
+        led.note_step(2, 0.5)
+        snap = led.snapshot()
+        assert snap["compile"] == pytest.approx(4.0)
+        assert snap["productive"] == pytest.approx(0.5)
+        rep = led.report()
+        assert rep["steps"] == 1  # the compile dispatch is not a step
+
+    def test_rollback_window_routes_to_recompute(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        led.note_step(1, 1.0)  # compile
+        for s in (1, 2, 3, 4):
+            led.note_step(s, 0.5)
+        clock.advance(10.0)
+        led.note_rollback(4, 2)  # rolled back from step 4 to the step-2 ckpt
+        for s in (3, 4):  # re-trained ground: recompute, not progress
+            led.note_step(s, 0.5)
+        led.note_step(5, 0.5)  # new ground again
+        snap = led.snapshot()
+        assert snap["rollback_recompute"] == pytest.approx(1.0)
+        assert snap["productive"] == pytest.approx(4 * 0.5 + 0.5)
+        rep = led.report()
+        assert rep["recompute_steps"] == 2 and rep["steps"] == 5
+
+    def test_double_rollback_keeps_highest_watermark(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        led.note_step(0, 1.0)  # compile
+        led.note_rollback(6, 2)
+        led.note_rollback(4, 2)  # older rollback must not lower the bar
+        for s in (3, 4, 5, 6):
+            led.note_step(s, 0.25)
+        led.note_step(7, 0.25)
+        snap = led.snapshot()
+        assert snap["rollback_recompute"] == pytest.approx(1.0)
+        assert snap["productive"] == pytest.approx(0.25)
+
+    def test_idle_is_the_residual(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        clock.advance(10.0)
+        led.add("productive", 3.0)
+        led.add("data_wait", 2.0)
+        snap = led.snapshot()
+        assert snap["idle"] == pytest.approx(5.0)
+        assert sum(snap.values()) == pytest.approx(led.wall_s())
+        assert led.fraction() == pytest.approx(0.3)
+        assert led.conservation_error() == 0.0
+
+    def test_over_attribution_is_detected(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        clock.advance(1.0)
+        led.add("productive", 3.0)  # charged more than the clock advanced
+        assert led.conservation_error() == pytest.approx(2.0)
+        assert led.snapshot()["idle"] == 0.0  # residual clamps at zero
+
+    def test_unknown_bucket_rejected(self):
+        led = _ledger(FakeClock())
+        with pytest.raises(KeyError):
+            led.add("coffee", 1.0)
+        with pytest.raises(KeyError):
+            led.add("idle", 1.0)  # idle is computed, never charged
+
+    def test_negative_spans_clamped(self):
+        clock = FakeClock()
+        led = _ledger(clock)
+        clock.advance(1.0)
+        led.add("eval", -5.0)
+        led.note_step(1, -2.0)
+        assert led.snapshot()["eval"] == 0.0
+        assert led.conservation_error() == 0.0
+
+    def test_report_shape_and_conservation(self):
+        clock = FakeClock()
+        led = _ledger(clock, generation=3)
+        clock.advance(4.0)
+        led.note_step(1, 1.5)
+        led.note_step(2, 0.5)
+        led.add("ckpt_save", 0.25)
+        rep = led.report(step=2, reason="interval")
+        assert rep["generation"] == 3
+        assert rep["step"] == 2 and rep["reason"] == "interval"
+        assert set(rep["buckets"]) == set(GOODPUT_BUCKETS)
+        assert rep["wall_s"] == pytest.approx(4.0)
+        assert rep["attributed_s"] + rep["idle_s"] == pytest.approx(4.0)
+        assert rep["conservation_error"] <= 0.01
+        assert rep["goodput_fraction"] == pytest.approx(0.5 / 4.0)
+
+    def test_publish_sets_gauges(self):
+        reg = MetricsRegistry()
+        clock = FakeClock()
+        led = GoodputLedger(registry=reg, clock=clock)
+        clock.advance(8.0)
+        led.note_step(1, 1.0)
+        led.note_step(2, 3.0)
+        led.publish()
+        assert _gauge_value(reg, "goodput_wall_seconds") == pytest.approx(8.0)
+        assert _gauge_value(reg, "goodput_fraction") == pytest.approx(3.0 / 8.0)
+        assert _gauge_value(
+            reg, "goodput_bucket_seconds", bucket="compile"
+        ) == pytest.approx(1.0)
+        assert _gauge_value(
+            reg, "goodput_bucket_seconds", bucket="idle"
+        ) == pytest.approx(4.0)
+        assert _gauge_value(reg, "goodput_recompute_steps") == 0.0
+
+    def test_thread_safety_conserves_under_contention(self):
+        import threading
+
+        clock = FakeClock()
+        led = _ledger(clock)
+        led.note_step(0, 0.0)  # burn the compile dispatch
+
+        def feed():
+            for i in range(500):
+                led.note_step(i, 0.001)
+                led.add("data_wait", 0.001)
+
+        threads = [threading.Thread(target=feed) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        clock.advance(10.0)  # wall comfortably exceeds attributed
+        snap = led.snapshot()
+        assert snap["productive"] == pytest.approx(2.0)
+        assert snap["data_wait"] == pytest.approx(2.0)
+        assert led.conservation_error() == 0.0
+
+
+def test_bucket_display_names():
+    assert bucket_display("restart_downtime") == "restart downtime"
+    assert bucket_display("productive") == "productive step compute"
+    assert bucket_display("not_a_bucket") == "not a bucket"
+
+
+# ----------------------------------------------------------------- advisor
+
+
+class TestCkptAdvisor:
+    def test_youngs_formula(self):
+        adv = advise_ckpt_interval(2.0, 10000.0, 0.5)
+        assert adv["interval_s"] == pytest.approx(200.0)  # √(2·2·10000)
+        assert adv["ckpt_every"] == 400
+        assert adv["mtbf_is_bound"] is False
+
+    def test_no_failures_uses_span_as_mtbf_bound(self):
+        adv = advise_ckpt_interval(1.0, 0.0, 0.1, observed_span_s=800.0)
+        assert adv["mtbf_is_bound"] is True
+        assert adv["mtbf_s"] == pytest.approx(800.0)
+        assert adv["interval_s"] == pytest.approx(40.0)
+        assert adv["ckpt_every"] == 400
+
+    def test_clamps_produce_a_sane_recommendation(self):
+        adv = advise_ckpt_interval(0.0, 0.0, 0.0)
+        assert adv["ckpt_every"] >= 1
+        assert adv["interval_s"] > 0
+        assert adv["mtbf_is_bound"] is True
+
+
+# ---------------------------------------------------------------- stitcher
+
+
+def _two_generation_events():
+    """A killed-and-relaunched elastic run, as its merged journal reads.
+
+    gen 0: launches at t=1000, compiles, trains to step 8, commits step 4,
+    wedges; the watchdog fires after a 4 s stall; the supervisor restarts
+    with 0.5 s backoff. gen 1: a fresh process whose ledger starts at
+    t=1020 (12 s after gen 0's last step activity), resumes from step 4,
+    re-trains to 6, reaches 10, commits 10, exits cleanly.
+    """
+    g0_buckets = {
+        "productive": 6.0,
+        "compile": 1.0,
+        "data_wait": 0.5,
+        "eval": 0.0,
+        "ckpt_save": 0.4,
+        "ckpt_restore": 0.0,
+        "rollback_recompute": 0.0,
+        "restart_downtime": 0.0,
+        "hang_latency": 0.0,
+        "idle": 4.6,
+    }
+    g1_buckets = {
+        "productive": 4.0,
+        "compile": 1.0,
+        "data_wait": 0.3,
+        "eval": 0.0,
+        "ckpt_save": 0.4,
+        "ckpt_restore": 0.5,
+        "rollback_recompute": 1.0,
+        "restart_downtime": 0.0,
+        "hang_latency": 0.0,
+        "idle": 2.8,
+    }
+    events = [
+        {"ts": 1000.5, "type": "run_start", "generation": 0, "start_step": 0},
+        *(
+            {"ts": 1000.0 + s, "type": "step", "step": s}
+            for s in range(1, 9)
+        ),
+        {
+            "ts": 1004.5,
+            "type": "checkpoint_save",
+            "step": 4,
+            "save_seconds": 0.4,
+        },
+        {
+            "ts": 1012.0,
+            "type": "hang_detected",
+            "step": 8,
+            "stalled_s": 4.0,
+            "deadline_s": 4.0,
+        },
+        # cumulative report emitted by the hang handler: ts − wall_s
+        # recovers the gen-0 ledger epoch t=1000
+        {
+            "ts": 1012.5,
+            "type": "goodput_report",
+            "generation": 0,
+            "wall_s": 12.5,
+            "steps": 7,
+            "buckets": g0_buckets,
+            "reason": "hang",
+        },
+        {
+            "ts": 1016.0,
+            "type": "elastic_restart",
+            "role": "supervisor",
+            "reason": "hang",
+            "generation": 1,
+            "old_world": 2,
+            "new_world": 2,
+            "backoff_s": 0.5,
+            "restarts_used": 1,
+        },
+        {"ts": 1020.5, "type": "run_start", "generation": 1, "start_step": 4},
+        *(
+            {"ts": 1021.0 + i, "type": "step", "step": 5 + i}
+            for i in range(6)
+        ),
+        {
+            "ts": 1027.0,
+            "type": "checkpoint_save",
+            "step": 10,
+            "save_seconds": 0.4,
+        },
+        # gen-1 ledger epoch: 1030 − 10 = 1020
+        {
+            "ts": 1030.0,
+            "type": "goodput_report",
+            "generation": 1,
+            "wall_s": 10.0,
+            "steps": 9,
+            "buckets": g1_buckets,
+            "reason": "completed",
+        },
+        {"ts": 1030.0, "type": "shutdown", "reason": "completed", "step": 10},
+    ]
+    return events
+
+
+class TestStitchGenerations:
+    def test_single_generation_passthrough(self):
+        events = [
+            {"ts": 10.0, "type": "run_start", "generation": 0, "start_step": 0},
+            {"ts": 12.0, "type": "step", "step": 2},
+            {
+                "ts": 14.0,
+                "type": "goodput_report",
+                "generation": 0,
+                "wall_s": 5.0,  # ledger epoch t=9
+                "steps": 2,
+                "buckets": {"productive": 3.0, "compile": 1.0, "idle": 1.0},
+            },
+            {"ts": 14.0, "type": "shutdown", "reason": "completed", "step": 2},
+        ]
+        g = stitch_generations(events)
+        assert g["failures"] == 0 and g["restarts"] == []
+        assert g["wall_s"] == pytest.approx(5.0)  # epoch 9 → last ts 14
+        assert g["buckets"]["productive"] == pytest.approx(3.0)
+        assert g["buckets"]["idle"] == pytest.approx(1.0)  # residual
+        assert g["goodput_fraction"] == pytest.approx(0.6)
+        assert g["conservation_error"] <= 0.01
+        assert g["mtbf_s"] is None
+
+    def test_restart_gap_split_and_lost_work(self):
+        g = stitch_generations(_two_generation_events())
+        assert g["failures"] == 1
+        (r,) = g["restarts"]
+        assert r["reason"] == "hang"
+        assert r["backoff_s"] == pytest.approx(0.5)
+        # gap = gen-1 ledger epoch (1020) − gen-0 last step activity (1008):
+        # the watchdog's observed 4 s stall is detection latency, the
+        # remaining 8 s is supervisor teardown + backoff + relaunch
+        assert r["downtime_s"] == pytest.approx(12.0)
+        assert r["detection_s"] == pytest.approx(4.0)
+        assert g["buckets"]["hang_latency"] == pytest.approx(4.0)
+        assert g["buckets"]["restart_downtime"] == pytest.approx(8.0)
+        # lost work: gen 0 executed to step 8 but only step 4 was committed
+        assert r["lost_steps"] == 4 and g["steps_lost"] == 4
+        assert r["lost_seconds"] == pytest.approx(4 * g["step_time_s"], rel=0.01)
+        assert g["steps_committed"] == 10
+
+    def test_stitched_conservation_and_derived_rates(self):
+        g = stitch_generations(_two_generation_events())
+        wall = g["wall_s"]
+        assert wall == pytest.approx(30.0)  # gen-0 epoch 1000 → shutdown 1030
+        assert sum(g["buckets"].values()) == pytest.approx(wall, rel=1e-6)
+        assert g["conservation_error"] <= 0.01
+        # in-process idle is NOT summed (it would double-count the stall
+        # the stitch charges to hang_latency); idle is the residual:
+        # 30 − gen-0 non-idle 7.9 − gen-1 non-idle 7.2 − gap 12
+        assert g["buckets"]["idle"] == pytest.approx(2.9, abs=0.01)
+        assert g["buckets"]["productive"] == pytest.approx(10.0)
+        assert g["goodput_fraction"] == pytest.approx(10.0 / 30.0, rel=1e-3)
+        assert g["mtbf_s"] == pytest.approx(30.0)  # 1 failure over the span
+        assert g["save_cost_s"] == pytest.approx(0.4)
+        assert g["step_time_s"] == pytest.approx(10.0 / 16)  # 7 + 9 steps
+
+    def test_non_host0_rows_ignored(self):
+        events = _two_generation_events()
+        # a host-1 report must not double the buckets
+        events.append(
+            {
+                "ts": 1029.0,
+                "type": "goodput_report",
+                "host": 1,
+                "generation": 1,
+                "wall_s": 9.0,
+                "steps": 9,
+                "buckets": {"productive": 99.0},
+            }
+        )
+        g = stitch_generations(events)
+        assert g["buckets"]["productive"] == pytest.approx(10.0)
+
+    def test_empty_journal(self):
+        g = stitch_generations([])
+        assert g["wall_s"] == 0.0 and g["failures"] == 0
+        assert g["goodput_fraction"] == 0.0
+        assert g["save_cost_s"] is None and g["step_time_s"] is None
+
+
+# ----------------------------------------------------------- fleet rollup
+
+
+class TestFleetGoodput:
+    def test_fleet_goodput_is_mean_over_live_hosts(self, tmp_path):
+        t0 = 1_700_000_000.0
+        HostBeacon(tmp_path, host=0).write(
+            step=10, now=t0, goodput_fraction=0.8, generation=1
+        )
+        HostBeacon(tmp_path, host=1).write(
+            step=10, now=t0, goodput_fraction=0.6, generation=1
+        )
+        reg = MetricsRegistry()
+        agg = FleetAggregator(tmp_path, expected_hosts=2, registry=reg)
+        s = agg.scan(now=t0 + 1)
+        assert s["goodput_fraction"] == pytest.approx(0.7)
+        assert _gauge_value(reg, "fleet_goodput") == pytest.approx(0.7)
+        assert _gauge_value(
+            reg, "fleet_goodput_fraction", host="1"
+        ) == pytest.approx(0.6)
+        assert _gauge_value(reg, "fleet_generation", host="0") == 1.0
+
+    def test_fleet_goodput_absent_without_beacon_field(self, tmp_path):
+        t0 = 1_700_000_000.0
+        HostBeacon(tmp_path, host=0).write(step=10, now=t0)
+        agg = FleetAggregator(tmp_path, expected_hosts=1, registry=MetricsRegistry())
+        s = agg.scan(now=t0 + 1)
+        assert s["goodput_fraction"] is None
+
+
+# ---------------------------------------------------------- goodput_doctor
+
+
+def _write_journal(directory: Path, events: list[dict]) -> None:
+    directory.mkdir(parents=True, exist_ok=True)
+    with open(directory / "journal-00000.jsonl", "w") as f:
+        for i, e in enumerate(events):
+            f.write(json.dumps({"seq": i, **e}) + "\n")
+
+
+class TestGoodputDoctor:
+    def test_exit_zero_names_restart_downtime_and_recommends(self, tmp_path):
+        import tools.goodput_doctor as doctor
+
+        _write_journal(tmp_path / "journal", _two_generation_events())
+        out = tmp_path / "goodput.md"
+        assert doctor.main([str(tmp_path), "--out", str(out)]) == 0
+        report = out.read_text()
+        # the verdict prices the incident: restart downtime is the top
+        # non-productive bucket of this stitched run
+        assert "top non-productive bucket: **restart downtime**" in report
+        assert "conservation: **OK**" in report
+        assert "1 restart(s) observed" in report
+        assert "stitched across 2 process generation(s)" in report
+        # every bucket has a row in the attribution table
+        for b in GOODPUT_BUCKETS:
+            assert f"| {bucket_display(b)} |" in report
+        # restart-cost breakdown: the hang restart with its lost work
+        assert "| 1 | hang |" in report
+        # and a concrete checkpoint-interval recommendation
+        assert "run.ckpt_every=" in report
+        assert "√(2·save_cost·MTBF)" in report
+
+    def test_exit_two_without_journal(self, tmp_path):
+        import tools.goodput_doctor as doctor
+
+        assert doctor.main([str(tmp_path / "nothing")]) == 2
+
+    def test_advisor_row_degrades_without_checkpoints(self, tmp_path):
+        import tools.goodput_doctor as doctor
+
+        events = [
+            {"ts": 10.0, "type": "run_start", "generation": 0, "start_step": 0},
+            {"ts": 12.0, "type": "shutdown", "reason": "completed", "step": 0},
+        ]
+        _write_journal(tmp_path / "journal", events)
+        out = tmp_path / "goodput.md"
+        assert doctor.main([str(tmp_path), "--out", str(out)]) == 0
+        assert "not enough data" in out.read_text()
+
+
+# ------------------------------------------------------ run_doctor timeline
+
+
+class TestRunDoctorElasticTimeline:
+    def test_elastic_lifecycle_events_rendered(self, tmp_path):
+        import tools.run_doctor as doctor
+
+        events = [
+            {"ts": 1.0, "type": "run_start", "start_step": 0},
+            {
+                "ts": 2.0,
+                "type": "hang_detected",
+                "step": 8,
+                "stalled_s": 4.0,
+                "deadline_s": 4.0,
+            },
+            {
+                "ts": 3.0,
+                "type": "elastic_restart",
+                "role": "supervisor",
+                "reason": "hang",
+                "generation": 1,
+                "failed_hosts": [1],
+                "old_world": 2,
+                "new_world": 1,
+                "backoff_s": 0.5,
+                "restarts_used": 1,
+            },
+            {
+                "ts": 4.0,
+                "type": "elastic_resize",
+                "cause": "shrink",
+                "step": 4,
+                "epoch": 0,
+                "old_world": 2,
+                "new_world": 1,
+                "shards_total": 8,
+                "shards_consumed": 3,
+                "shards_remaining": 5,
+            },
+            {
+                "ts": 5.0,
+                "type": "ckpt_fallback",
+                "from_step": 8,
+                "to_step": 4,
+                "error": "manifest truncated",
+            },
+            {
+                "ts": 6.0,
+                "type": "elastic_rejoin",
+                "role": "supervisor",
+                "generation": 2,
+                "old_world": 1,
+                "new_world": 2,
+            },
+            {"ts": 7.0, "type": "shutdown", "reason": "completed", "step": 10},
+        ]
+        _write_journal(tmp_path / "journal", events)
+        out = tmp_path / "report.md"
+        assert doctor.main([str(tmp_path), "--out", str(out)]) == 0
+        report = out.read_text()
+        assert "hang_detected" in report
+        assert "no progress for 4.0s" in report
+        assert "elastic_restart" in report
+        assert "gen 1: hang, world 2 → 1" in report
+        assert "elastic_resize" in report
+        assert "shrink: world 2 → 1 at step 4" in report
+        assert "5/8 shards unconsumed" in report
+        assert "ckpt_fallback" in report
+        assert "restore walked back step 8 → 4" in report
+        assert "elastic_rejoin" in report
+        assert "graceful restart back to full size" in report
+
+
+# -------------------------------------- conservation on real train() runs
+#
+# Property: after any in-process run — clean or faulted — the journal's
+# final goodput_report conserves wall-clock (attribution error ≤ 1%) and
+# its buckets account for the failure mode the plan injected. Slow: the
+# CI goodput chaos smoke runs these alongside the supervisor-level legs.
+
+
+def _smoke_overrides(tmp_path, steps, extra=()):
+    return [
+        f"run.output_dir={tmp_path}",
+        f"run.training_steps={steps}",
+        f"optim.training_steps={steps}",
+        "run.sanity_eval=false",
+        "run.log_interval=2",
+        "run.eval_interval=4",
+        *extra,
+    ]
+
+
+def _final_report(run_dir: Path) -> dict:
+    events = read_journal(run_dir / "journal")
+    reports = [e for e in events if e["type"] == "goodput_report"]
+    assert reports, "run emitted no goodput_report events"
+    assert events[-1]["type"] == "shutdown"
+    # the shutdown-adjacent report is the cumulative final word
+    return reports[-1]
+
+
+def _assert_conserved(rep: dict) -> None:
+    assert rep["conservation_error"] <= 0.01, rep
+    total = sum(rep["buckets"].values())
+    assert total == pytest.approx(rep["wall_s"], rel=0.02, abs=0.05), rep
+    assert rep["attributed_s"] + rep["idle_s"] == pytest.approx(
+        rep["wall_s"], rel=0.01, abs=0.02
+    )
+
+
+@pytest.mark.slow
+def test_conservation_clean_run(tmp_path):
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml", _smoke_overrides(tmp_path, 8)
+        )
+    )
+    rep = _final_report(tmp_path / "smoke_cpu")
+    _assert_conserved(rep)
+    assert rep["steps"] == 7  # 8 dispatches − the compile dispatch
+    assert rep["buckets"]["productive"] > 0
+    assert rep["buckets"]["compile"] > 0  # first dispatch traced+compiled
+    assert rep["buckets"]["ckpt_save"] > 0
+    assert rep["buckets"]["rollback_recompute"] == 0.0
+    assert rep["reason"] == "completed"
+    assert rep["generation"] == 0
+    # interval checkpoints at 4 and 8 each journaled a cumulative report,
+    # monotone in wall-clock
+    events = read_journal(tmp_path / "smoke_cpu" / "journal")
+    walls = [
+        e["wall_s"] for e in events if e["type"] == "goodput_report"
+    ]
+    assert len(walls) >= 3  # ckpt@4, ckpt@8, shutdown
+    assert walls == sorted(walls)
+
+
+@pytest.mark.slow
+def test_conservation_under_nan_rollback(tmp_path, fault_plan):
+    """NaN at steps 5-7 → sentinel rollback to step 4 → the re-trained
+    ground is recompute, not productive — and the books still balance."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    final = train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml",
+            _smoke_overrides(
+                tmp_path,
+                12,
+                [
+                    "run.faults=train.loss:nan@n=4..6",
+                    "run.log_interval=1",
+                    "run.sentinel_patience=3",
+                ],
+            ),
+        )
+    )
+    assert math.isfinite(final["train/loss"])
+    rep = _final_report(tmp_path / "smoke_cpu")
+    _assert_conserved(rep)
+    assert rep["recompute_steps"] > 0
+    assert rep["buckets"]["rollback_recompute"] > 0
+    assert rep["buckets"]["ckpt_restore"] > 0  # the rollback restored
+
+
+@pytest.mark.slow
+def test_conservation_under_slow_checkpoint(tmp_path, fault_plan):
+    """An injected 0.5 s checkpoint-save delay lands in ckpt_save — the
+    ledger prices the save, it does not vanish into idle."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml",
+            _smoke_overrides(
+                tmp_path, 8, ["run.faults=ckpt.save:delay(0.5)@n<1"]
+            ),
+        )
+    )
+    rep = _final_report(tmp_path / "smoke_cpu")
+    _assert_conserved(rep)
+    assert rep["buckets"]["ckpt_save"] >= 0.5
+
+
+@pytest.mark.slow
+def test_conservation_under_fleet_wedge(tmp_path, fault_plan):
+    """A 1 s collective wedge (no hangwatch — in-process) shows up as
+    non-productive time and the invariant holds."""
+    from jumbo_mae_tpu_tpu.cli.train import train
+
+    train(
+        load_config(
+            RECIPES / "smoke_cpu.yaml",
+            _smoke_overrides(
+                tmp_path, 8, ["run.faults=fleet.wedge:delay(1.0)@n<1"]
+            ),
+        )
+    )
+    rep = _final_report(tmp_path / "smoke_cpu")
+    _assert_conserved(rep)
+    # the wedge second is real wall-clock somewhere non-productive
+    nonprod = rep["wall_s"] - rep["buckets"]["productive"]
+    assert nonprod >= 1.0

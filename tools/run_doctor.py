@@ -302,13 +302,20 @@ def diagnose(events: list[dict], flight: dict | None = None) -> str:
         "profile",
         "compiled_program",
         "shutdown",
+        # supervisor rows (train/elastic.py) live in host-0's journal dir
+        "elastic_restart",
+        "elastic_rejoin",
+        "elastic_exhausted",
     )
     fleet_types = ("fleet_straggler", "fleet_host_lost", "fleet_host_rejoined")
+    # events any host may emit about itself: keep every host's, host-tagged
+    any_host_types = ("elastic_resize", "hang_detected", "ckpt_fallback")
     interesting = [
         e
         for e in events
         if (e.get("type") in per_run_types and (not multi or _host_of(e) == 0))
         or e.get("type") in fleet_types
+        or e.get("type") in any_host_types
         or e.get("type") == "flight_record"
     ]
     if not interesting:
@@ -348,6 +355,43 @@ def diagnose(events: list[dict], flight: dict | None = None) -> str:
                 f"{_fmt_host(e.get('host_id'))} at step {e.get('step')} "
                 f"after {e.get('lost_for_s')}s"
             )
+        elif etype == "elastic_restart":
+            detail = (
+                f"gen {e.get('generation')}: {e.get('reason')}, world "
+                f"{e.get('old_world')} → {e.get('new_world')}, failed hosts "
+                f"{e.get('failed_hosts')}, backoff {e.get('backoff_s')}s "
+                f"(restart #{e.get('restarts_used')})"
+            )
+        elif etype == "elastic_rejoin":
+            detail = (
+                f"gen {e.get('generation')}: world {e.get('old_world')} → "
+                f"{e.get('new_world')} (graceful restart back to full size)"
+            )
+        elif etype == "elastic_exhausted":
+            detail = f"{e.get('verdict')} (reason {e.get('reason')})"
+        elif etype == "elastic_resize":
+            detail = (
+                f"{e.get('cause')}: world {e.get('old_world')} → "
+                f"{e.get('new_world')} at step {e.get('step')}, epoch "
+                f"{e.get('epoch')} resumes with {e.get('shards_remaining')}/"
+                f"{e.get('shards_total')} shards unconsumed"
+            )
+            if multi:
+                detail = f"[host {_host_of(e)}] {detail}"
+        elif etype == "hang_detected":
+            detail = (
+                f"step {e.get('step')}: no progress for "
+                f"{e.get('stalled_s')}s (deadline {e.get('deadline_s')}s)"
+            )
+            if multi:
+                detail = f"[host {_host_of(e)}] {detail}"
+        elif etype == "ckpt_fallback":
+            detail = (
+                f"restore walked back step {e.get('from_step')} → "
+                f"{e.get('to_step')} ({e.get('error')})"
+            )
+            if multi:
+                detail = f"[host {_host_of(e)}] {detail}"
         elif etype == "shutdown":
             detail = f"{e.get('reason')} at step {e.get('step')}"
         elif etype == "run_start":
